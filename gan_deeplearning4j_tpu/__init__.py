@@ -1,0 +1,33 @@
+"""gan_deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch JAX/XLA framework with the capability surface of the reference
+``hamaadshah/gan_deeplearning4j`` stack (DL4J ComputationGraph + ND4J + Spark
+parameter averaging + cuDNN kernels), re-designed TPU-first:
+
+- named-layer computation graphs with per-layer updaters, LR-0 freezing,
+  transfer-learning graph surgery, named-parameter get/set
+  (reference binding: dl4jGANComputerVision.java:118-314,337-364,429-542);
+- ops lowered through XLA to the TPU MXU (conv/dense as lax convolutions and
+  dot_generals in NHWC, bf16-friendly) instead of cuDNN/cuBLAS kernels
+  (reference: Java/pom.xml:119-128);
+- data parallelism via jax.sharding Mesh + XLA all-reduce over ICI instead of
+  Spark synchronous parameter averaging (reference:
+  dl4jGANComputerVision.java:317-330);
+- device-resident data pipeline, checkpointing with updater state, and an
+  alternating GAN training harness (reference: dl4jGANComputerVision.java:408-621).
+"""
+
+__version__ = "0.1.0"
+
+from gan_deeplearning4j_tpu.runtime.environment import TpuEnvironment, backend_info
+from gan_deeplearning4j_tpu.runtime import factory
+from gan_deeplearning4j_tpu.runtime.dtype import get_default_dtype, set_default_dtype
+
+__all__ = [
+    "TpuEnvironment",
+    "backend_info",
+    "factory",
+    "get_default_dtype",
+    "set_default_dtype",
+    "__version__",
+]

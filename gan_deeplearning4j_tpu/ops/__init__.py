@@ -1,0 +1,12 @@
+"""Functional op layer — the TPU-native replacement for libnd4j/cuDNN kernels.
+
+Where the reference dispatches Conv2D/BatchNorm/Subsampling to cuDNN and dense
+GEMMs to cuBLAS (Java/pom.xml:119-128; SURVEY §2.2 D2-D4), every op here is a
+pure function lowered by XLA onto the TPU MXU/VPU. Layout is NHWC (TPU's
+preferred conv layout) rather than ND4J's NCHW; the nn layer handles the
+boundary reshapes.
+"""
+
+from gan_deeplearning4j_tpu.ops import activations, conv, linear, losses, norm, initializers, clipping
+
+__all__ = ["activations", "conv", "linear", "losses", "norm", "initializers", "clipping"]

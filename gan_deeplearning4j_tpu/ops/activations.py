@@ -1,0 +1,61 @@
+"""Activations used by the reference: tanh (hidden default), sigmoid (dis/gen
+outputs), softmax (classifier), identity (reference:
+dl4jGANComputerVision.java:126,159-162,215,303-307,358-362). A few extras
+(relu/leaky_relu/elu) round out the zoo for the non-MNIST model families.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def identity(x):
+    return x
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def leaky_relu(x, negative_slope: float = 0.2):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def elu(x):
+    return jax.nn.elu(x)
+
+
+_REGISTRY = {
+    "identity": identity,
+    "linear": identity,
+    "tanh": tanh,
+    "sigmoid": sigmoid,
+    "softmax": softmax,
+    "relu": relu,
+    "leakyrelu": leaky_relu,
+    "leaky_relu": leaky_relu,
+    "elu": elu,
+}
+
+
+def get(name_or_fn):
+    """Resolve an activation by name (case-insensitive) or pass through a callable."""
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown activation {name_or_fn!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

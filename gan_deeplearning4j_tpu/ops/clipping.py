@@ -1,0 +1,26 @@
+"""Gradient normalization.
+
+The reference configures ``GradientNormalization.ClipElementWiseAbsoluteValue``
+with threshold 1.0 on every graph (dl4jGANComputerVision.java:124-125 et al.):
+each gradient element is clamped to [-t, t] before the updater runs.
+Clip-by-global-norm is provided for the wider model families.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def clip_elementwise(grads, threshold: float):
+    """Clamp every gradient element to [-threshold, threshold] (DL4J
+    ClipElementWiseAbsoluteValue)."""
+    t = jnp.asarray(threshold)
+    return jax.tree_util.tree_map(lambda g: jnp.clip(g, -t, t), grads)
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    leaves = jax.tree_util.tree_leaves(grads)
+    global_norm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / (global_norm + 1e-12))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), grads)

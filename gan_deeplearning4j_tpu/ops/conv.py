@@ -1,0 +1,142 @@
+"""Convolution / pooling / upsampling ops (NHWC, TPU layout).
+
+TPU-native replacement for the cuDNN kernels the reference binds
+(deeplearning4j-cuda Conv2D/Subsampling, Java/pom.xml:124-128) and the
+Upsampling2D layer (dl4jGANComputerVision.java:201-219). XLA's TPU conv
+emitter plays cuDNN's role: ``lax.conv_general_dilated`` in NHWC/HWIO maps
+straight onto the MXU; pooling is a ``reduce_window``; nearest-neighbor
+upsampling is a broadcast-reshape that XLA fuses into the following conv's
+input.
+
+Shape semantics match DL4J's ``ConvolutionMode.Truncate`` (the reference's
+default): out = floor((in + 2p - k) / s) + 1, which is exactly XLA's explicit
+padding + VALID windowing.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gan_deeplearning4j_tpu.runtime.dtype import get_compute_dtype
+
+IntPair = Union[int, Tuple[int, int], Sequence[int]]
+
+
+def _pair(v: IntPair) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    a, b = v
+    return (int(a), int(b))
+
+
+def conv_out_size(in_size: int, kernel: int, stride: int, padding: int) -> int:
+    """DL4J Truncate-mode output size: floor((in + 2p - k)/s) + 1."""
+    return (in_size + 2 * padding - kernel) // stride + 1
+
+
+def conv2d(x, w, b=None, *, stride: IntPair = 1, padding: IntPair = 0):
+    """2-D convolution, NHWC input, HWIO kernel, explicit symmetric padding.
+
+    Runs the contraction in the compute dtype (bf16 under mixed precision)
+    with float32 accumulation via ``preferred_element_type``.
+    """
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    out_dtype = x.dtype
+    cdt = get_compute_dtype()
+    y = lax.conv_general_dilated(
+        x.astype(cdt),
+        w.astype(cdt),
+        window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    y = y.astype(out_dtype)
+    if b is not None:
+        y = y + b  # (out,) broadcasts over NHW
+    return y
+
+
+def conv2d_transpose(x, w, b=None, *, stride: IntPair = 1, padding: IntPair = 0):
+    """Transposed convolution (Deconvolution2D analog for the wider DCGAN
+    family; the reference's generator uses upsample+conv instead,
+    dl4jGANComputerVision.java:201-219, but DL4J ships Deconvolution2D and the
+    CIFAR/CelebA configs in BASELINE.md exercise it).
+
+    Shape: out = (in - 1) * s - 2p + k, the inverse of :func:`conv_out_size`.
+    """
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    out_dtype = x.dtype
+    cdt = get_compute_dtype()
+    kh, kw = w.shape[0], w.shape[1]
+    y = lax.conv_transpose(
+        x.astype(cdt),
+        w.astype(cdt),
+        strides=(sh, sw),
+        padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    y = y.astype(out_dtype)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def max_pool2d(x, *, kernel: IntPair, stride: IntPair, padding: IntPair = 0):
+    """Max pooling over NHWC (SubsamplingLayer MAX analog,
+    dl4jGANComputerVision.java:139-143,150-154 — kernel 2x2 stride 1)."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x,
+        neg_inf,
+        lax.max,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+    )
+
+
+def avg_pool2d(x, *, kernel: IntPair, stride: IntPair, padding: IntPair = 0):
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    summed = lax.reduce_window(
+        x,
+        jnp.zeros((), x.dtype),
+        lax.add,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+    )
+    if ph == 0 and pw == 0:
+        return summed / (kh * kw)
+    ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+    counts = lax.reduce_window(
+        ones,
+        jnp.zeros((), x.dtype),
+        lax.add,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=((0, 0), (ph, ph), (pw, pw), (0, 0)),
+    )
+    return summed / counts
+
+
+def upsample2d(x, *, scale: IntPair = 2):
+    """Nearest-neighbor upsampling (Upsampling2D analog). Implemented as a
+    broadcast+reshape — zero FLOPs; XLA fuses it into the consumer conv."""
+    sh, sw = _pair(scale)
+    n, h, w, c = x.shape
+    y = x[:, :, None, :, None, :]
+    y = jnp.broadcast_to(y, (n, h, sh, w, sw, c))
+    return y.reshape(n, h * sh, w * sw, c)

@@ -1,0 +1,80 @@
+"""Weight initializers.
+
+The reference uses ``WeightInit.XAVIER`` everywhere
+(dl4jGANComputerVision.java:127 et al.). DL4J's XAVIER is a *Gaussian*
+N(0, 2/(fan_in+fan_out)); we reproduce that as the default and provide the
+uniform variant plus He/normal/zeros for the wider layer zoo.
+
+Fan-in/fan-out convention: dense kernels are (in, out); conv kernels are HWIO
+(kh, kw, in, out) with receptive-field scaling, matching XLA's native layout.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv HWIO: receptive field * channels
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def xavier(key, shape, dtype=jnp.float32):
+    """DL4J WeightInit.XAVIER: gaussian with var = 2/(fan_in+fan_out)."""
+    fan_in, fan_out = _fans(shape)
+    std = math.sqrt(2.0 / (fan_in + fan_out))
+    return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+def he_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    std = math.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+def normal(key, shape, dtype=jnp.float32, stddev=0.01):
+    return stddev * jax.random.normal(key, shape, dtype=dtype)
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.zeros(shape, dtype=dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.ones(shape, dtype=dtype)
+
+
+_REGISTRY = {
+    "xavier": xavier,
+    "xavier_uniform": xavier_uniform,
+    "he": he_normal,
+    "he_normal": he_normal,
+    "normal": normal,
+    "zeros": zeros,
+    "ones": ones,
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown initializer {name_or_fn!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

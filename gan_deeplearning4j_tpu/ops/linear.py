@@ -1,0 +1,30 @@
+"""Dense (fully-connected) op.
+
+The reference's DenseLayer GEMMs run on cuBLAS (SURVEY §3.3 hot loop); here a
+single ``dot_general`` that XLA tiles onto the MXU. Inputs/outputs stay in the
+storage dtype; the contraction runs in the compute dtype (bfloat16 when mixed
+precision is enabled) with float32 accumulation — the TPU-native fast path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.runtime.dtype import get_compute_dtype
+
+
+def dense(x, w, b=None):
+    """y = x @ w + b with MXU-friendly dtypes.
+
+    Args:
+      x: (batch, in) activations.
+      w: (in, out) kernel.
+      b: optional (out,) bias.
+    """
+    out_dtype = x.dtype
+    cdt = get_compute_dtype()
+    y = jnp.matmul(x.astype(cdt), w.astype(cdt), preferred_element_type=jnp.float32)
+    y = y.astype(out_dtype)
+    if b is not None:
+        y = y + b
+    return y

@@ -1,0 +1,81 @@
+"""Loss functions.
+
+The reference uses ``LossFunction.XENT`` with sigmoid (discriminator/GAN
+output, dl4jGANComputerVision.java:159-162,303-307) and ``MCXENT`` with
+softmax (classifier head, :358-362). DL4J's XENT clamps probabilities to
+[eps, 1-eps] with eps=1e-5 before the log — reproduced here for parity.
+Wasserstein + gradient-penalty losses cover the WGAN-GP config in BASELINE.md
+(grad-of-grad flows through XLA natively).
+
+Score convention: mean over batch of the summed per-example loss — DL4J's
+``score()`` — so gradients match the reference's scale.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+XENT_CLIP_EPS = 1e-5
+
+
+def binary_xent(probs, labels, *, eps: float = XENT_CLIP_EPS):
+    """XENT on sigmoid outputs (probabilities), DL4J LossBinaryXENT."""
+    p = jnp.clip(probs, eps, 1.0 - eps)
+    per_example = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log(1.0 - p))
+    return jnp.mean(jnp.sum(per_example, axis=tuple(range(1, per_example.ndim))))
+
+
+def categorical_xent(probs, labels, *, eps: float = XENT_CLIP_EPS):
+    """MCXENT on softmax outputs (probabilities), DL4J LossMCXENT."""
+    p = jnp.clip(probs, eps, 1.0 - eps)
+    per_example = -jnp.sum(labels * jnp.log(p), axis=-1)
+    return jnp.mean(per_example)
+
+
+def mse(preds, labels):
+    per_example = jnp.sum((preds - labels) ** 2, axis=tuple(range(1, preds.ndim)))
+    return jnp.mean(per_example)
+
+
+def wasserstein(critic_scores, labels):
+    """Wasserstein critic loss: labels ∈ {+1 (real), -1 (fake)} —
+    minimizes -E[D(real)] + E[D(fake)]."""
+    return -jnp.mean(critic_scores * labels)
+
+
+def gradient_penalty(critic_fn, real, fake, rng, *, target: float = 1.0):
+    """WGAN-GP penalty E[(||∇_x D(x̂)||₂ − 1)²] at x̂ = εx + (1−ε)x̃.
+
+    ``critic_fn`` maps a batch to per-example scores. The grad-of-grad this
+    needs is plain ``jax.grad`` composition — XLA lowers it natively (the
+    BASELINE.md WGAN-GP config's whole point)."""
+    eps_shape = (real.shape[0],) + (1,) * (real.ndim - 1)
+    epsilon = jax.random.uniform(rng, eps_shape, dtype=real.dtype)
+    x_hat = epsilon * real + (1.0 - epsilon) * fake
+
+    def scalar_critic(x):
+        return jnp.sum(critic_fn(x))
+
+    grads = jax.grad(scalar_critic)(x_hat)
+    norms = jnp.sqrt(jnp.sum(grads**2, axis=tuple(range(1, grads.ndim))) + 1e-12)
+    return jnp.mean((norms - target) ** 2)
+
+
+_REGISTRY = {
+    "xent": binary_xent,
+    "binary_xent": binary_xent,
+    "mcxent": categorical_xent,
+    "categorical_xent": categorical_xent,
+    "mse": mse,
+    "wasserstein": wasserstein,
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown loss {name_or_fn!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]

@@ -1,0 +1,48 @@
+"""Batch normalization (functional).
+
+Replaces the cuDNN BatchNorm kernel the reference binds
+(Java/pom.xml:124-128; layers at dl4jGANComputerVision.java:132-135,186-199).
+DL4J semantics reproduced:
+
+- normalizes over all axes except the channel/feature axis (last axis here:
+  features for 2-D inputs, channels for NHWC 4-D inputs);
+- running mean/var are *named parameters* (``mean``/``var``) updated during the
+  training forward pass with DL4J's default decay 0.9
+  (running = decay * running + (1-decay) * batch_stat) — the reference copies
+  them between graphs by name every iteration
+  (dl4jGANComputerVision.java:437-440,498-500,523-527), so they must live in
+  the param tree, not hidden module state;
+- inference uses the running statistics.
+
+DL4J default eps = 1e-5.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+DEFAULT_EPS = 1e-5
+DEFAULT_DECAY = 0.9
+
+
+def batch_norm_train(
+    x, gamma, beta, running_mean, running_var, *, eps: float = DEFAULT_EPS, decay: float = DEFAULT_DECAY
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Training-mode BN: normalize by batch statistics, return updated running
+    stats. Reduction axes = all but the last (feature/channel) axis."""
+    axes = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=axes)
+    # population variance (ddof=0), matching cuDNN/DL4J forward
+    var = jnp.var(x, axis=axes)
+    inv = jnp.reciprocal(jnp.sqrt(var + eps))
+    y = (x - mean) * inv * gamma + beta
+    new_mean = decay * running_mean + (1.0 - decay) * mean
+    new_var = decay * running_var + (1.0 - decay) * var
+    return y, new_mean, new_var
+
+
+def batch_norm_inference(x, gamma, beta, running_mean, running_var, *, eps: float = DEFAULT_EPS):
+    inv = jnp.reciprocal(jnp.sqrt(running_var + eps))
+    return (x - running_mean) * inv * gamma + beta

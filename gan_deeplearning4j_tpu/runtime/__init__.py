@@ -1,0 +1,23 @@
+"""Runtime core: dtype policy, seeded PRNG streams, array factory, device environment.
+
+TPU-native replacement for the reference's ND4J/CUDA runtime layer
+(Nd4j.setDataType / CudaEnvironment / Nd4j factory surface,
+dl4jGANComputerVision.java:103-115).
+"""
+
+from gan_deeplearning4j_tpu.runtime.dtype import (
+    get_default_dtype,
+    set_default_dtype,
+    default_dtype_scope,
+)
+from gan_deeplearning4j_tpu.runtime.prng import RngStream
+from gan_deeplearning4j_tpu.runtime.environment import TpuEnvironment, backend_info
+
+__all__ = [
+    "get_default_dtype",
+    "set_default_dtype",
+    "default_dtype_scope",
+    "RngStream",
+    "TpuEnvironment",
+    "backend_info",
+]

@@ -1,0 +1,65 @@
+"""Global dtype policy.
+
+TPU-native analog of ``Nd4j.setDataType(DataBuffer.Type.FLOAT)``
+(reference: dl4jGANComputerVision.java:105). The reference pins a single global
+float32 dtype; on TPU we additionally expose a *compute* dtype so matmuls/convs
+can run in bfloat16 on the MXU while parameters stay float32.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _get_state():
+    if not hasattr(_state, "default_dtype"):
+        _state.default_dtype = jnp.float32
+        _state.compute_dtype = None  # None => same as default
+    return _state
+
+
+def set_default_dtype(dtype) -> None:
+    """Set the global parameter/storage dtype (reference default: float32)."""
+    _get_state().default_dtype = jnp.dtype(dtype)
+
+
+def get_default_dtype():
+    return _get_state().default_dtype
+
+
+def set_compute_dtype(dtype) -> None:
+    """Set the MXU compute dtype (e.g. ``jnp.bfloat16``). ``None`` disables mixed
+    precision and computes in the default dtype."""
+    _get_state().compute_dtype = None if dtype is None else jnp.dtype(dtype)
+
+
+def get_compute_dtype():
+    st = _get_state()
+    return st.compute_dtype if st.compute_dtype is not None else st.default_dtype
+
+
+@contextlib.contextmanager
+def default_dtype_scope(dtype):
+    st = _get_state()
+    prev = st.default_dtype
+    st.default_dtype = jnp.dtype(dtype)
+    try:
+        yield
+    finally:
+        st.default_dtype = prev
+
+
+@contextlib.contextmanager
+def compute_dtype_scope(dtype):
+    st = _get_state()
+    prev = st.compute_dtype
+    st.compute_dtype = None if dtype is None else jnp.dtype(dtype)
+    try:
+        yield
+    finally:
+        st.compute_dtype = prev

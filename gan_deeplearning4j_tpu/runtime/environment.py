@@ -1,0 +1,95 @@
+"""Device environment & runtime configuration.
+
+TPU-native replacement for ``CudaEnvironment.getInstance().getConfiguration()``
+(reference: dl4jGANComputerVision.java:107-111) and the backend identification
+print (``Nd4j.getBackend()``, :114). Where the reference configures a CUDA
+JITA allocator (multi-GPU, 2 GiB device cache, P2P cross-device access), the
+TPU runtime's analogs are: PJRT owns HBM allocation, ICI provides cross-device
+access natively, and multi-device execution is expressed through a
+``jax.sharding.Mesh`` rather than toggled on.
+
+``TpuEnvironment`` therefore carries the knobs that *do* exist on this stack:
+platform selection, visible device count, mesh axis layout, verbosity, and the
+memory-pressure escape hatches XLA exposes (rematerialization policy, donation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def backend_info() -> dict:
+    """Identify the execution backend (analog of ``Nd4j.getBackend()`` print,
+    dl4jGANComputerVision.java:114)."""
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform if devices else jax.default_backend(),
+        "device_count": len(devices),
+        "local_device_count": jax.local_device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "devices": [str(d) for d in devices],
+    }
+
+
+@dataclasses.dataclass
+class TpuEnvironment:
+    """Runtime configuration (analog of the CUDA env block I3, SURVEY §2.1).
+
+    Attributes:
+      allow_multi_device: use all visible devices for the data mesh (analog of
+        ``allowMultiGPU(true)``; on TPU this is the default and free).
+      device_limit: cap the number of devices used (None = all). Replaces the
+        reference's 2 GiB device-cache cap as the resource-limiting knob — HBM
+        allocation itself is PJRT's job.
+      mesh_axes: axis names for the device mesh; the reference only exercises
+        data parallelism, but the mesh leaves a ``model`` axis open (SURVEY
+        §2.3).
+      verbose: log device/backend details (analog of ``setVerbose(true)``).
+    """
+
+    allow_multi_device: bool = True
+    device_limit: Optional[int] = None
+    mesh_axes: Tuple[str, ...] = ("data",)
+    verbose: bool = False
+
+    def devices(self) -> list:
+        devs = jax.devices()
+        if not self.allow_multi_device:
+            devs = devs[:1]
+        if self.device_limit is not None:
+            devs = devs[: self.device_limit]
+        return devs
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def make_mesh(self, axis_sizes: Optional[Sequence[int]] = None) -> jax.sharding.Mesh:
+        """Build the device mesh. With the default single ``data`` axis, all
+        visible devices form a 1-D data-parallel mesh — the TPU-native
+        equivalent of Spark's ``local[4]`` worker pool
+        (dl4jGANComputerVision.java:318), except the "workers" are chips on ICI.
+        """
+        devs = self.devices()
+        if axis_sizes is None:
+            axis_sizes = [len(devs)] + [1] * (len(self.mesh_axes) - 1)
+        if int(np.prod(axis_sizes)) != len(devs):
+            raise ValueError(
+                f"mesh axis sizes {tuple(axis_sizes)} do not cover {len(devs)} devices"
+            )
+        mesh_devices = np.asarray(devs).reshape(axis_sizes)
+        mesh = jax.sharding.Mesh(mesh_devices, self.mesh_axes)
+        if self.verbose:
+            logger.info("Mesh: %s over %s", dict(zip(self.mesh_axes, mesh_devices.shape)), backend_info())
+        return mesh
+
+    def log_backend(self) -> None:
+        info = backend_info()
+        logger.info("Execution backend: %s", info)

@@ -1,0 +1,98 @@
+"""Array factory — the ``Nd4j`` static-factory surface, device-resident.
+
+TPU-native equivalent of the ND4J factory ops the reference exercises
+(``Nd4j.randn/rand/create/linspace/ones/zeros/vstack`` and in-place
+``muli/subi/addi``/``reshape``, dl4jGANComputerVision.java:105,170,382-388,
+404-406,420,465,551-552). Arrays are ordinary ``jax.Array``s living in device
+HBM (via PJRT under the hood); "in-place" ND4J mutation becomes functional
+updates, which XLA turns into buffer reuse/donation.
+
+All factories honor the global dtype policy (runtime.dtype) and take explicit
+PRNG keys (or an :class:`RngStream`) instead of ND4J's hidden global RNG.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gan_deeplearning4j_tpu.runtime.dtype import get_default_dtype
+from gan_deeplearning4j_tpu.runtime.prng import RngStream
+
+
+def _resolve_key(rng):
+    if isinstance(rng, RngStream):
+        return rng.next_key()
+    return rng
+
+
+def _dtype(dtype):
+    return get_default_dtype() if dtype is None else jnp.dtype(dtype)
+
+
+def randn(rng, *shape, dtype=None):
+    """Standard-normal samples (Nd4j.randn analog)."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return jax.random.normal(_resolve_key(rng), shape, dtype=_dtype(dtype))
+
+
+def rand(rng, *shape, dtype=None, minval=0.0, maxval=1.0):
+    """Uniform samples in [minval, maxval) (Nd4j.rand analog)."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return jax.random.uniform(
+        _resolve_key(rng), shape, dtype=_dtype(dtype), minval=minval, maxval=maxval
+    )
+
+
+def uniform_latent(rng, *shape, dtype=None):
+    """z ~ U(-1, 1) — the reference's latent sampler ``rand·2−1``
+    (dl4jGANComputerVision.java:420,465)."""
+    return rand(rng, *shape, dtype=dtype, minval=-1.0, maxval=1.0)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=_dtype(dtype))
+
+
+def ones(*shape, dtype=None):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return jnp.ones(shape, dtype=_dtype(dtype))
+
+
+def zeros(*shape, dtype=None):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return jnp.zeros(shape, dtype=_dtype(dtype))
+
+
+def create(data, dtype=None):
+    """Materialize host data as a device array (Nd4j.create analog)."""
+    return jnp.asarray(np.asarray(data), dtype=_dtype(dtype))
+
+
+def vstack(arrays: Sequence[jax.Array]):
+    """Row-stack (Nd4j.vstack analog, dl4jGANComputerVision.java:551,581)."""
+    return jnp.concatenate([jnp.atleast_2d(a) for a in arrays], axis=0)
+
+
+def latent_grid(side: int, low: float = -1.0, high: float = 1.0, dtype=None):
+    """The reference's z-grid for latent-manifold plots: a ``side × side``
+    cartesian grid over ``linspace(low, high, side)²`` flattened to
+    ``(side², 2)`` (dl4jGANComputerVision.java:382-389)."""
+    axis = jnp.linspace(low, high, side, dtype=_dtype(dtype))
+    xx, yy = jnp.meshgrid(axis, axis, indexing="ij")
+    return jnp.stack([xx.reshape(-1), yy.reshape(-1)], axis=-1)
+
+
+def to_host(array) -> np.ndarray:
+    """Explicit device→host transfer. The only sanctioned host readout point —
+    the reference's per-scalar ``getDouble`` reads
+    (dl4jGANComputerVision.java:558,587) are deliberately not reproduced; batch
+    reads through this instead."""
+    return np.asarray(jax.device_get(array))

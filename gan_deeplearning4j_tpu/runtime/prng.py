@@ -1,0 +1,47 @@
+"""Seeded PRNG streams.
+
+The reference seeds every graph with one integer seed (seed 666 at
+dl4jGANComputerVision.java:121,176,231) and draws from a global stateful RNG
+(Nd4j.randn/rand). JAX PRNG is functional; ``RngStream`` wraps key-splitting in
+a small stateful facade so framework code (array factory, init, dropout) gets
+DL4J-like ergonomics while staying reproducible and jit-friendly (keys are
+split *outside* traced code).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class RngStream:
+    """A stateful stream of PRNG keys derived from one seed.
+
+    Each call to :meth:`next_key` returns a fresh key; the stream is
+    deterministic given the seed. Not safe for use inside ``jax.jit`` traces —
+    draw keys outside and pass them in.
+    """
+
+    def __init__(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def next_keys(self, n: int):
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return list(subs)
+
+    def fork(self) -> "RngStream":
+        """A new independent stream (seeded from this one's next key)."""
+        child = RngStream(self._seed)
+        child._key = self.next_key()
+        return child
+
+    def reset(self) -> None:
+        self._key = jax.random.PRNGKey(self._seed)

@@ -1,0 +1,29 @@
+"""Test configuration: run everything on a fake 8-device CPU mesh.
+
+The reference's trick for exercising the distributed path without a cluster is
+Spark ``local[4]`` (dl4jGANComputerVision.java:318). Ours is XLA's host
+platform with 8 virtual devices, so data-parallel/all-reduce paths run in CI
+without TPUs (SURVEY §4 item 4). Must be set before jax initializes a backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers the TPU PJRT plugin and pins
+# jax_platforms via jax.config, which wins over the env var — pin it back.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(666)

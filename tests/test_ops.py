@@ -1,0 +1,261 @@
+"""Op-layer tests: analytic values, shape parity with DL4J Truncate mode, and
+finite-difference gradient checks (SURVEY §4's prescribed test pyramid)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.ops import activations, clipping, conv, initializers, linear, losses, norm
+
+
+def fd_grad(f, x, eps=1e-4):
+    """Central finite differences of scalar f at x."""
+    x = np.asarray(x, dtype=np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (f(jnp.asarray(xp, jnp.float32)) - f(jnp.asarray(xm, jnp.float32))) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestActivations:
+    def test_values(self):
+        x = jnp.array([-1.0, 0.0, 1.0])
+        np.testing.assert_allclose(activations.tanh(x), np.tanh([-1, 0, 1]), atol=1e-6)
+        np.testing.assert_allclose(
+            activations.sigmoid(x), 1 / (1 + np.exp([1.0, 0.0, -1.0])), atol=1e-6
+        )
+        s = activations.softmax(jnp.array([[1.0, 2.0, 3.0]]))
+        np.testing.assert_allclose(np.sum(np.asarray(s)), 1.0, atol=1e-6)
+
+    def test_registry(self):
+        assert activations.get("TANH") is activations.tanh
+        assert activations.get(activations.relu) is activations.relu
+        with pytest.raises(KeyError):
+            activations.get("nope")
+
+
+class TestDense:
+    def test_matmul_bias(self):
+        x = jnp.array([[1.0, 2.0]])
+        w = jnp.array([[1.0, 0.0, 1.0], [0.0, 1.0, 1.0]])
+        b = jnp.array([0.5, 0.5, 0.5])
+        y = linear.dense(x, w, b)
+        np.testing.assert_allclose(np.asarray(y), [[1.5, 2.5, 3.5]], atol=1e-6)
+
+
+class TestConv:
+    def test_out_size_matches_reference_dis(self):
+        # dis topology (dl4jGANComputerVision.java:136-154): 28 -> conv5 s2 -> 12
+        # -> pool2 s1 -> 11 -> conv5 s2 -> 4 -> pool2 s1 -> 3
+        assert conv.conv_out_size(28, 5, 2, 0) == 12
+        assert conv.conv_out_size(12, 2, 1, 0) == 11
+        assert conv.conv_out_size(11, 5, 2, 0) == 4
+        assert conv.conv_out_size(4, 2, 1, 0) == 3
+
+    def test_conv2d_identity_kernel(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        w = jnp.zeros((3, 3, 1, 1)).at[1, 1, 0, 0].set(1.0)
+        y = conv.conv2d(x, w, stride=1, padding=1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
+
+    def test_conv2d_shapes(self):
+        x = jnp.ones((2, 28, 28, 1))
+        w = jnp.ones((5, 5, 1, 64))
+        y = conv.conv2d(x, w, stride=2, padding=0)
+        assert y.shape == (2, 12, 12, 64)
+        # generator conv: 5x5 s1 p2 preserves spatial dims (:207-213)
+        x2 = jnp.ones((2, 14, 14, 128))
+        w2 = jnp.ones((5, 5, 128, 64))
+        assert conv.conv2d(x2, w2, stride=1, padding=2).shape == (2, 14, 14, 64)
+
+    def test_conv2d_vs_manual(self):
+        k = jax.random.PRNGKey(0)
+        x = jax.random.normal(k, (1, 5, 5, 2))
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 2, 3))
+        y = conv.conv2d(x, w, stride=1, padding=0)
+        xn, wn = np.asarray(x), np.asarray(w)
+        expect = np.zeros((1, 3, 3, 3))
+        for i in range(3):
+            for j in range(3):
+                patch = xn[0, i : i + 3, j : j + 3, :]
+                for o in range(3):
+                    expect[0, i, j, o] = np.sum(patch * wn[:, :, :, o])
+        np.testing.assert_allclose(np.asarray(y), expect, atol=1e-4)
+
+    def test_conv_transpose_shape(self):
+        x = jnp.ones((2, 7, 7, 128))
+        w = jnp.ones((4, 4, 128, 64))
+        y = conv.conv2d_transpose(x, w, stride=2, padding=1)
+        assert y.shape == (2, 14, 14, 64)  # (7-1)*2 - 2 + 4 = 14
+
+    def test_max_pool(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        y = conv.max_pool2d(x, kernel=2, stride=1)
+        assert y.shape == (1, 3, 3, 1)
+        assert float(y[0, 0, 0, 0]) == 5.0  # max of [[0,1],[4,5]]
+        assert float(y[0, 2, 2, 0]) == 15.0
+
+    def test_avg_pool(self):
+        x = jnp.arange(4.0).reshape(1, 2, 2, 1)
+        y = conv.avg_pool2d(x, kernel=2, stride=1)
+        np.testing.assert_allclose(float(y[0, 0, 0, 0]), 1.5)
+
+    def test_upsample(self):
+        x = jnp.array([[1.0, 2.0], [3.0, 4.0]]).reshape(1, 2, 2, 1)
+        y = conv.upsample2d(x, scale=2)
+        assert y.shape == (1, 4, 4, 1)
+        np.testing.assert_allclose(
+            np.asarray(y[0, :, :, 0]),
+            [[1, 1, 2, 2], [1, 1, 2, 2], [3, 3, 4, 4], [3, 3, 4, 4]],
+        )
+
+
+class TestBatchNorm:
+    def test_train_normalizes(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 8)) * 3.0 + 5.0
+        gamma, beta = jnp.ones(8), jnp.zeros(8)
+        rm, rv = jnp.zeros(8), jnp.ones(8)
+        y, nm, nv = norm.batch_norm_train(x, gamma, beta, rm, rv)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, 0)), np.zeros(8), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(jnp.std(y, 0)), np.ones(8), atol=1e-2)
+        # running stats moved toward batch stats with decay 0.9
+        np.testing.assert_allclose(np.asarray(nm), 0.1 * np.asarray(jnp.mean(x, 0)), atol=1e-5)
+
+    def test_inference_uses_running_stats(self):
+        x = jnp.ones((4, 3)) * 2.0
+        y = norm.batch_norm_inference(
+            x, jnp.ones(3), jnp.zeros(3), jnp.ones(3) * 2.0, jnp.ones(3)
+        )
+        np.testing.assert_allclose(np.asarray(y), np.zeros((4, 3)), atol=1e-3)
+
+    def test_nhwc_reduction_axes(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 6, 6, 4)) * 2 + 1
+        y, _, _ = norm.batch_norm_train(x, jnp.ones(4), jnp.zeros(4), jnp.zeros(4), jnp.ones(4))
+        m = np.asarray(jnp.mean(y, axis=(0, 1, 2)))
+        np.testing.assert_allclose(m, np.zeros(4), atol=1e-5)
+
+
+class TestLosses:
+    def test_binary_xent_analytic(self):
+        p = jnp.array([[0.9], [0.1]])
+        t = jnp.array([[1.0], [0.0]])
+        expect = -np.mean([np.log(0.9), np.log(0.9)])
+        np.testing.assert_allclose(float(losses.binary_xent(p, t)), expect, atol=1e-5)
+
+    def test_binary_xent_clips(self):
+        p = jnp.array([[0.0], [1.0]])
+        t = jnp.array([[1.0], [0.0]])
+        v = float(losses.binary_xent(p, t))
+        assert np.isfinite(v)
+        np.testing.assert_allclose(v, -np.log(1e-5), rtol=1e-4)
+
+    def test_categorical_xent(self):
+        p = jnp.array([[0.7, 0.2, 0.1]])
+        t = jnp.array([[1.0, 0.0, 0.0]])
+        np.testing.assert_allclose(float(losses.categorical_xent(p, t)), -np.log(0.7), atol=1e-5)
+
+    def test_wasserstein(self):
+        scores = jnp.array([2.0, -1.0])
+        labels = jnp.array([1.0, -1.0])
+        np.testing.assert_allclose(float(losses.wasserstein(scores, labels)), -1.5)
+
+    def test_gradient_penalty_zero_for_unit_grad(self):
+        # critic(x) = sum(x) has gradient exactly 1 per element; with 1-d x the
+        # norm is 1 so the penalty vanishes.
+        real = jnp.ones((8, 1))
+        fake = jnp.zeros((8, 1))
+        gp = losses.gradient_penalty(
+            lambda x: jnp.sum(x, axis=1), real, fake, jax.random.PRNGKey(0)
+        )
+        np.testing.assert_allclose(float(gp), 0.0, atol=1e-6)
+
+    def test_gradient_penalty_grad_of_grad(self):
+        # differentiating through the penalty (grad-of-grad) must work
+        w = jnp.array(2.0)
+        real = jnp.ones((4, 3))
+        fake = jnp.zeros((4, 3))
+
+        def outer(w):
+            return losses.gradient_penalty(
+                lambda x: w * jnp.sum(x, axis=(1,)), real, fake, jax.random.PRNGKey(1)
+            )
+
+        g = jax.grad(outer)(w)
+        assert np.isfinite(float(g)) and abs(float(g)) > 0
+
+
+class TestGradients:
+    """Finite-difference checks of op gradients."""
+
+    def test_dense_grad(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 4))
+        w0 = jax.random.normal(jax.random.PRNGKey(1), (4, 2))
+
+        def f(w):
+            return jnp.sum(jnp.tanh(linear.dense(x, w)))
+
+        g = jax.grad(f)(w0)
+        g_fd = fd_grad(lambda w: float(f(w)), w0)
+        np.testing.assert_allclose(np.asarray(g), g_fd, atol=1e-2)
+
+    def test_conv_grad(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 5, 5, 1))
+        w0 = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 1, 2)) * 0.5
+
+        def f(w):
+            return jnp.sum(conv.conv2d(x, w, stride=1, padding=1) ** 2)
+
+        g = jax.grad(f)(w0)
+        g_fd = fd_grad(lambda w: float(f(w)), w0)
+        np.testing.assert_allclose(np.asarray(g), g_fd, atol=1e-1, rtol=1e-2)
+
+    def test_bn_grad(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 3))
+        g0 = jnp.ones(3)
+
+        def f(gamma):
+            y, _, _ = norm.batch_norm_train(x, gamma, jnp.zeros(3), jnp.zeros(3), jnp.ones(3))
+            return jnp.sum(y**2)
+
+        g = jax.grad(f)(g0)
+        g_fd = fd_grad(lambda gm: float(f(gm)), g0)
+        np.testing.assert_allclose(np.asarray(g), g_fd, atol=1e-2, rtol=1e-2)
+
+
+class TestClipping:
+    def test_elementwise(self):
+        grads = {"a": jnp.array([-5.0, 0.5, 3.0])}
+        out = clipping.clip_elementwise(grads, 1.0)
+        np.testing.assert_allclose(np.asarray(out["a"]), [-1.0, 0.5, 1.0])
+
+    def test_global_norm(self):
+        grads = {"a": jnp.array([3.0, 4.0])}  # norm 5
+        out = clipping.clip_by_global_norm(grads, 1.0)
+        np.testing.assert_allclose(np.asarray(out["a"]), [0.6, 0.8], atol=1e-5)
+
+
+class TestInitializers:
+    def test_xavier_stats(self):
+        w = initializers.xavier(jax.random.PRNGKey(0), (1000, 500))
+        expect_std = np.sqrt(2.0 / 1500)
+        assert abs(float(jnp.std(w)) - expect_std) < 0.05 * expect_std
+        assert abs(float(jnp.mean(w))) < 1e-2
+
+    def test_conv_fans(self):
+        # HWIO (5,5,1,64): fan_in = 25, fan_out = 1600
+        w = initializers.xavier(jax.random.PRNGKey(0), (5, 5, 1, 64))
+        expect_std = np.sqrt(2.0 / (25 + 1600))
+        assert abs(float(jnp.std(w)) - expect_std) < 0.1 * expect_std
+
+    def test_registry(self):
+        assert initializers.get("XAVIER") is initializers.xavier
+        with pytest.raises(KeyError):
+            initializers.get("bogus")
