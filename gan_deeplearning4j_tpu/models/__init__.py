@@ -1,0 +1,6 @@
+"""Model zoo: the reference DCGAN-MNIST family plus the BASELINE.md configs
+(tabular MLP-GAN, CIFAR-10 DCGAN, CelebA-64 DCGAN, WGAN-GP critic)."""
+
+from gan_deeplearning4j_tpu.models import dcgan_mnist
+
+__all__ = ["dcgan_mnist"]

@@ -1,0 +1,258 @@
+"""The reference's DCGAN-MNIST model family — three graphs + the transfer
+classifier, with the reference's exact layer names, topology and hyperparameters
+(dl4jGANComputerVision.java:117-314,335-368), built on this framework's
+TPU-native graph system.
+
+Architecture parity notes:
+- Per-layer RmsProp(lr, 1e-8, 1e-8) exactly as the reference attaches them;
+  "frozen" layers use LR 0.0 (:84).
+- Layer names match the reference string-for-string because the weight-sync
+  protocol (:429-542) addresses parameters by (layer, name); the sync mappings
+  below are the same copies expressed as bulk ``copy_params`` maps.
+- ``gen_deconv2d_5``/``gen_deconv2d_7`` are Upsampling2D layers (the reference
+  names them deconv but builds Upsampling2D, :201-206,210-214).
+- The dis graph declares ``InputType.convolutionalFlat(28,28,1)`` (:165);
+  batch/conv layers see NHWC activations via the automatic flat→cnn adapter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gan_deeplearning4j_tpu.nn import (
+    BatchNormalization,
+    ComputationGraph,
+    ConvolutionLayer,
+    DenseLayer,
+    FeedForwardToCnnPreProcessor,
+    FineTuneConfiguration,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+    SubsamplingLayer,
+    TransferLearning,
+    Upsampling2D,
+)
+from gan_deeplearning4j_tpu.optim import RmsProp
+
+
+@dataclasses.dataclass(frozen=True)
+class DcganConfig:
+    """The reference's hyperparameter block (dl4jGANComputerVision.java:66-92),
+    model-side subset."""
+
+    height: int = 28
+    width: int = 28
+    channels: int = 1
+    num_features: int = 784
+    num_classes: int = 10
+    num_classes_dis: int = 1
+    z_size: int = 2
+    dis_learning_rate: float = 0.002
+    gen_learning_rate: float = 0.004
+    frozen_learning_rate: float = 0.0
+    seed: int = 666  # numberOfTheBeast
+    l2: float = 1e-4
+    grad_clip: float = 1.0
+
+
+def _graph_config(cfg: DcganConfig) -> GraphConfig:
+    # common block of every reference graph (:119-129)
+    return GraphConfig(
+        seed=cfg.seed,
+        default_activation="tanh",
+        weight_init="xavier",
+        l2=cfg.l2,
+        gradient_clip="elementwise",
+        gradient_clip_value=cfg.grad_clip,
+        updater=RmsProp(cfg.dis_learning_rate, 1e-8, 1e-8),
+        optimization_algo="sgd",
+    )
+
+
+def build_discriminator(cfg: DcganConfig = DcganConfig()) -> ComputationGraph:
+    """Trainable discriminator ``dis`` (dl4jGANComputerVision.java:118-166)."""
+    up = RmsProp(cfg.dis_learning_rate, 1e-8, 1e-8)
+    b = GraphBuilder(_graph_config(cfg))
+    b.add_inputs("dis_input_layer_0")
+    b.set_input_types(InputType.convolutional_flat(cfg.height, cfg.width, cfg.channels))
+    b.add_layer("dis_batch_layer_1", BatchNormalization(updater=up), "dis_input_layer_0")
+    b.add_layer(
+        "dis_conv2d_layer_2",
+        ConvolutionLayer(kernel=5, stride=2, n_in=cfg.channels, n_out=64, updater=up),
+        "dis_batch_layer_1",
+    )
+    b.add_layer(
+        "dis_maxpool_layer_3",
+        SubsamplingLayer(pool="max", kernel=2, stride=1),
+        "dis_conv2d_layer_2",
+    )
+    b.add_layer(
+        "dis_conv2d_layer_4",
+        ConvolutionLayer(kernel=5, stride=2, n_in=64, n_out=128, updater=up),
+        "dis_maxpool_layer_3",
+    )
+    b.add_layer(
+        "dis_maxpool_layer_5",
+        SubsamplingLayer(pool="max", kernel=2, stride=1),
+        "dis_conv2d_layer_4",
+    )
+    b.add_layer("dis_dense_layer_6", DenseLayer(n_out=1024, updater=up), "dis_maxpool_layer_5")
+    b.add_layer(
+        "dis_output_layer_7",
+        OutputLayer(n_out=cfg.num_classes_dis, activation="sigmoid", loss="xent", updater=up),
+        "dis_dense_layer_6",
+    )
+    b.set_outputs("dis_output_layer_7")
+    return b.build()
+
+
+def _add_generator_layers(b: GraphBuilder, prefix: str, lr: float, cfg: DcganConfig, input_name: str) -> str:
+    """The 8-layer generator stack shared by ``gen`` (frozen LR) and ``gan``
+    (LR 0.004) — dl4jGANComputerVision.java:186-220 vs :240-274. Layer names
+    keep the reference's ``{prefix}_...`` scheme; returns the output name."""
+    up = RmsProp(lr, 1e-8, 1e-8)
+    dense3 = 7 * 7 * 128
+    b.add_layer(f"{prefix}_batch_1", BatchNormalization(updater=up), input_name)
+    b.add_layer(f"{prefix}_dense_layer_2", DenseLayer(n_out=1024, updater=up), f"{prefix}_batch_1")
+    b.add_layer(
+        f"{prefix}_dense_layer_3", DenseLayer(n_out=dense3, updater=up), f"{prefix}_dense_layer_2"
+    )
+    b.add_layer(f"{prefix}_batch_4", BatchNormalization(updater=up), f"{prefix}_dense_layer_3")
+    b.add_layer(
+        f"{prefix}_deconv2d_5",
+        Upsampling2D(size=2),
+        f"{prefix}_batch_4",
+        preprocessor=FeedForwardToCnnPreProcessor(7, 7, 128),
+    )
+    b.add_layer(
+        f"{prefix}_conv2d_6",
+        ConvolutionLayer(kernel=5, stride=1, padding=2, n_in=128, n_out=64, updater=up),
+        f"{prefix}_deconv2d_5",
+    )
+    b.add_layer(f"{prefix}_deconv2d_7", Upsampling2D(size=2), f"{prefix}_conv2d_6")
+    b.add_layer(
+        f"{prefix}_conv2d_8",
+        ConvolutionLayer(
+            kernel=5, stride=1, padding=2, n_in=64, n_out=cfg.channels,
+            activation="sigmoid", updater=up,
+        ),
+        f"{prefix}_deconv2d_7",
+    )
+    return f"{prefix}_conv2d_8"
+
+
+def build_generator(cfg: DcganConfig = DcganConfig()) -> ComputationGraph:
+    """Frozen sampler ``gen`` — all updaters LR 0.0; weights refreshed by
+    copying from ``gan`` (dl4jGANComputerVision.java:172-225)."""
+    b = GraphBuilder(_graph_config(cfg))
+    b.add_inputs("gen_input_layer_0")
+    b.set_input_types(InputType.feed_forward(cfg.z_size))
+    out = _add_generator_layers(b, "gen", cfg.frozen_learning_rate, cfg, "gen_input_layer_0")
+    b.set_outputs(out)
+    return b.build()
+
+
+def build_gan(cfg: DcganConfig = DcganConfig()) -> ComputationGraph:
+    """Stacked GAN: trainable generator (LR 0.004) feeding a frozen
+    discriminator copy (LR 0.0), one XENT loss at the end so generator
+    gradients flow through the frozen D (dl4jGANComputerVision.java:227-314)."""
+    frozen = RmsProp(cfg.frozen_learning_rate, 1e-8, 1e-8)
+    b = GraphBuilder(_graph_config(cfg))
+    b.add_inputs("gan_input_layer_0")
+    b.set_input_types(InputType.feed_forward(cfg.z_size))
+    gen_out = _add_generator_layers(b, "gan", cfg.gen_learning_rate, cfg, "gan_input_layer_0")
+    b.add_layer("gan_dis_batch_layer_9", BatchNormalization(updater=frozen), gen_out)
+    b.add_layer(
+        "gan_dis_conv2d_layer_10",
+        ConvolutionLayer(kernel=5, stride=2, n_in=cfg.channels, n_out=64, updater=frozen),
+        "gan_dis_batch_layer_9",
+    )
+    b.add_layer(
+        "gan_dis_maxpool_layer_11",
+        SubsamplingLayer(pool="max", kernel=2, stride=1),
+        "gan_dis_conv2d_layer_10",
+    )
+    b.add_layer(
+        "gan_dis_conv2d_layer_12",
+        ConvolutionLayer(kernel=5, stride=2, n_in=64, n_out=128, updater=frozen),
+        "gan_dis_maxpool_layer_11",
+    )
+    b.add_layer(
+        "gan_dis_maxpool_layer_13",
+        SubsamplingLayer(pool="max", kernel=2, stride=1),
+        "gan_dis_conv2d_layer_12",
+    )
+    b.add_layer(
+        "gan_dis_dense_layer_14", DenseLayer(n_out=1024, updater=frozen), "gan_dis_maxpool_layer_13"
+    )
+    b.add_layer(
+        "gan_dis_output_layer_15",
+        OutputLayer(n_out=cfg.num_classes_dis, activation="sigmoid", loss="xent", updater=frozen),
+        "gan_dis_dense_layer_14",
+    )
+    b.set_outputs("gan_dis_output_layer_15")
+    return b.build()
+
+
+def build_transfer_classifier(dis_graph: ComputationGraph, dis_params, cfg: DcganConfig = DcganConfig()):
+    """The ``computerVision`` classifier: dis features frozen below
+    ``dis_dense_layer_6``, old sigmoid head replaced by BatchNorm(1024) +
+    Softmax(10) under MCXENT (dl4jGANComputerVision.java:335-368). The new
+    output head reuses the name ``dis_output_layer_7`` as the reference does."""
+    up = RmsProp(cfg.dis_learning_rate, 1e-8, 1e-8)
+    return (
+        TransferLearning(dis_graph, dis_params)
+        .fine_tune_configuration(
+            FineTuneConfiguration(
+                seed=cfg.seed,
+                default_activation="tanh",
+                weight_init="xavier",
+                l2=cfg.l2,
+                gradient_clip="elementwise",
+                gradient_clip_value=cfg.grad_clip,
+                updater=up,
+                optimization_algo="sgd",
+            )
+        )
+        .set_feature_extractor("dis_dense_layer_6")
+        .remove_vertex_keep_connections("dis_output_layer_7")
+        .add_layer("dis_batch", BatchNormalization(updater=up), "dis_dense_layer_6")
+        .add_layer(
+            "dis_output_layer_7",
+            OutputLayer(n_out=cfg.num_classes, activation="softmax", loss="mcxent", updater=up),
+            "dis_batch",
+        )
+        .build()
+    )
+
+
+# --- weight-sync protocol (dl4jGANComputerVision.java:429-542) -------------
+# dis → gan frozen tail: refresh the stacked GAN's discriminator copy after a
+# dis step (12 named-param copies in the reference; here one bulk map).
+DIS_TO_GAN = {
+    "dis_batch_layer_1": "gan_dis_batch_layer_9",
+    "dis_conv2d_layer_2": "gan_dis_conv2d_layer_10",
+    "dis_conv2d_layer_4": "gan_dis_conv2d_layer_12",
+    "dis_dense_layer_6": "gan_dis_dense_layer_14",
+    "dis_output_layer_7": "gan_dis_output_layer_15",
+}
+
+# gan → gen: refresh the frozen sampler after a generator step (16 copies).
+GAN_TO_GEN = {
+    "gan_batch_1": "gen_batch_1",
+    "gan_dense_layer_2": "gen_dense_layer_2",
+    "gan_dense_layer_3": "gen_dense_layer_3",
+    "gan_batch_4": "gen_batch_4",
+    "gan_conv2d_6": "gen_conv2d_6",
+    "gan_conv2d_8": "gen_conv2d_8",
+}
+
+# dis → classifier feature layers (10 copies; head layers excluded).
+DIS_TO_CV = {
+    "dis_batch_layer_1": "dis_batch_layer_1",
+    "dis_conv2d_layer_2": "dis_conv2d_layer_2",
+    "dis_conv2d_layer_4": "dis_conv2d_layer_4",
+    "dis_dense_layer_6": "dis_dense_layer_6",
+}

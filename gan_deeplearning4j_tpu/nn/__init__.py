@@ -1,0 +1,53 @@
+"""Graph/module system — the DL4J ``ComputationGraph`` capability surface,
+rebuilt functionally for JAX (SURVEY §2.2 D6-D7, D11).
+
+Key properties preserved from the reference (dl4jGANComputerVision.java:118-314):
+stable layer names, named-parameter get/set (the weight-sync protocol at
+:429-542 depends on it), per-layer updater configs, declared-InputType shape
+inference, ``init``/``summary``/``output``, and transfer-learning graph
+surgery (:337-364). Parameters are a plain nested dict pytree — jit/pjit
+shardable, checkpointable, and name-addressable.
+"""
+
+from gan_deeplearning4j_tpu.nn.input_type import InputType
+from gan_deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    Deconvolution2D,
+    DenseLayer,
+    DropoutLayer,
+    Layer,
+    LossLayer,
+    OutputLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+)
+from gan_deeplearning4j_tpu.nn.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+)
+from gan_deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder, GraphConfig
+from gan_deeplearning4j_tpu.nn.transfer import FineTuneConfiguration, TransferLearning
+
+__all__ = [
+    "InputType",
+    "Layer",
+    "ActivationLayer",
+    "BatchNormalization",
+    "ConvolutionLayer",
+    "Deconvolution2D",
+    "DenseLayer",
+    "DropoutLayer",
+    "LossLayer",
+    "OutputLayer",
+    "SubsamplingLayer",
+    "Upsampling2D",
+    "CnnToFeedForwardPreProcessor",
+    "FeedForwardToCnnPreProcessor",
+    "ComputationGraph",
+    "GraphBuilder",
+    "GraphConfig",
+    "FineTuneConfiguration",
+    "TransferLearning",
+]

@@ -1,0 +1,549 @@
+"""ComputationGraph — named-layer DAG with shape inference (SURVEY §2.2 D6).
+
+The reference builds three graphs through DL4J's
+``NeuralNetConfiguration.Builder → graphBuilder() → ComputationGraph``
+(dl4jGANComputerVision.java:118-314). This module reproduces that capability
+surface functionally:
+
+- ``GraphBuilder``: ``add_inputs`` / ``set_input_types`` / ``add_layer`` /
+  ``add_vertex`` / ``set_outputs`` with graph-level defaults (seed, default
+  activation, weight init, L2, grad-clip, default updater) that per-layer
+  settings override — DL4J's config inheritance.
+- Automatic boundary preprocessors from declared InputTypes (DL4J inserts
+  FeedForwardToCnn/CnnToFeedForward implicitly; the flat→cnn insertion in
+  front of the first BatchNorm mirrors DL4J's CNNFlat handling, which is why
+  the reference's ``dis_batch_layer_1`` normalizes 1 channel, not 784
+  features).
+- ``ComputationGraph``: ``init`` (seeded, deterministic), ``apply`` (pure:
+  params in → outputs + updated BN stats out), ``output`` (inference),
+  ``loss`` (output-layer losses + L2), ``summary``, named-param
+  ``get_param``/``set_param``/``copy_params`` (the reference's weight-sync
+  protocol, :429-542), and ``to_dict``/``from_dict`` for checkpointing.
+
+Everything is jit-compatible: params are a nested dict pytree, ``train`` is a
+static flag, and the vertex iteration is unrolled Python (static graph), so
+XLA sees one flat computation to fuse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.nn.input_type import InputType
+from gan_deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    Deconvolution2D,
+    DenseLayer,
+    Layer,
+    LossLayer,
+    OutputLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    layer_from_dict,
+)
+from gan_deeplearning4j_tpu.nn.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FlatToCnnPreProcessor,
+    preprocessor_from_dict,
+)
+from gan_deeplearning4j_tpu.optim.updaters import RmsProp, UpdaterSpec, updater_from_dict
+
+_CNN_LAYERS = (ConvolutionLayer, Deconvolution2D, SubsamplingLayer, Upsampling2D)
+_FF_LAYERS = (DenseLayer,)  # OutputLayer subclasses DenseLayer
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphConfig:
+    """Graph-level defaults (DL4J NeuralNetConfiguration.Builder chain,
+    dl4jGANComputerVision.java:121-129): seed, SGD optimization algo,
+    elementwise grad clip @1.0, L2 1e-4, tanh default activation, Xavier."""
+
+    seed: int = 666
+    default_activation: str = "tanh"
+    weight_init: str = "xavier"
+    l2: float = 0.0
+    gradient_clip: Optional[str] = None  # "elementwise" | "global_norm" | None
+    gradient_clip_value: float = 1.0
+    updater: UpdaterSpec = RmsProp(0.001)
+    optimization_algo: str = "sgd"  # informational, as in the reference
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["updater"] = self.updater.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "GraphConfig":
+        d = dict(d)
+        d["updater"] = updater_from_dict(d["updater"])
+        return GraphConfig(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeVertex:
+    """Concatenate inputs along the trailing feature/channel axis (DL4J
+    MergeVertex)."""
+
+    def apply(self, xs: Sequence[jnp.ndarray]):
+        return jnp.concatenate(list(xs), axis=-1)
+
+    def output_type(self, in_types: Sequence[InputType]) -> InputType:
+        kinds = {t.kind for t in in_types}
+        if kinds == {"ff"}:
+            return InputType.feed_forward(sum(t.shape[0] for t in in_types))
+        if kinds == {"cnn"}:
+            h, w, _ = in_types[0].shape
+            return InputType.convolutional(h, w, sum(t.shape[2] for t in in_types))
+        raise ValueError(f"MergeVertex: incompatible input kinds {kinds}")
+
+    def to_dict(self) -> dict:
+        return {"type": "MergeVertex"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ElementWiseVertex:
+    """Elementwise combine (DL4J ElementWiseVertex: Add/Subtract/Product)."""
+
+    op: str = "add"
+
+    def apply(self, xs: Sequence[jnp.ndarray]):
+        out = xs[0]
+        for x in xs[1:]:
+            if self.op == "add":
+                out = out + x
+            elif self.op == "product":
+                out = out * x
+            elif self.op == "subtract":
+                out = out - x
+            else:
+                raise ValueError(f"unknown elementwise op {self.op!r}")
+        return out
+
+    def output_type(self, in_types: Sequence[InputType]) -> InputType:
+        return in_types[0]
+
+    def to_dict(self) -> dict:
+        return {"type": "ElementWiseVertex", "op": self.op}
+
+
+def _vertex_from_dict(d: dict):
+    if d["type"] == "MergeVertex":
+        return MergeVertex()
+    if d["type"] == "ElementWiseVertex":
+        return ElementWiseVertex(d["op"])
+    raise KeyError(f"unknown vertex type {d['type']!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class VertexSpec:
+    """A resolved node: either a Layer (with optional preprocessor) or a
+    combining vertex. ``raw_layer`` keeps the pre-default-resolution config
+    (None fields = "inherit") so graph surgery can re-resolve against a
+    fine-tuned config, DL4J FineTuneConfiguration-style."""
+
+    name: str
+    inputs: Tuple[str, ...]
+    layer: Optional[Layer] = None
+    vertex: Optional[object] = None
+    preprocessor: Optional[object] = None
+    in_type: Optional[InputType] = None
+    out_type: Optional[InputType] = None
+    raw_layer: Optional[Layer] = None
+
+
+class GraphBuilder:
+    """DL4J ``graphBuilder()`` analog."""
+
+    def __init__(self, config: GraphConfig = GraphConfig()):
+        self.config = config
+        self._inputs: List[str] = []
+        self._input_types: List[InputType] = []
+        self._nodes: List[dict] = []
+        self._outputs: List[str] = []
+        self._names: set = set()
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        for n in names:
+            if n in self._names:
+                raise ValueError(f"duplicate name {n!r}")
+            self._names.add(n)
+        self._inputs.extend(names)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        self._input_types = list(types)
+        return self
+
+    def add_layer(
+        self, name: str, layer: Layer, *inputs: str, preprocessor=None
+    ) -> "GraphBuilder":
+        if name in self._names:
+            raise ValueError(f"duplicate name {name!r}")
+        self._names.add(name)
+        self._nodes.append(
+            {"name": name, "layer": layer, "inputs": tuple(inputs), "preprocessor": preprocessor}
+        )
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str) -> "GraphBuilder":
+        if name in self._names:
+            raise ValueError(f"duplicate name {name!r}")
+        self._names.add(name)
+        self._nodes.append({"name": name, "vertex": vertex, "inputs": tuple(inputs)})
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    # ------------------------------------------------------------------
+    def _resolve_layer_defaults(self, layer: Layer) -> Layer:
+        """Fill in None fields from the graph config (DL4J inheritance)."""
+        updates = {}
+        if layer.activation is None and not isinstance(
+            layer, (BatchNormalization, Upsampling2D, SubsamplingLayer)
+        ):
+            updates["activation"] = self.config.default_activation
+        if layer.activation is None and isinstance(layer, BatchNormalization):
+            updates["activation"] = "identity"
+        if layer.weight_init is None:
+            updates["weight_init"] = self.config.weight_init
+        if layer.updater is None:
+            updates["updater"] = self.config.updater
+        if layer.l2 is None:
+            updates["l2"] = self.config.l2
+        return dataclasses.replace(layer, **updates) if updates else layer
+
+    @staticmethod
+    def _auto_preprocessor(layer: Layer, in_type: InputType):
+        """DL4J's implicit InputType adaptation."""
+        if isinstance(layer, (*_CNN_LAYERS, BatchNormalization)) and in_type.kind == "cnn_flat":
+            h, w, c = in_type.shape
+            return FlatToCnnPreProcessor(h, w, c)
+        if isinstance(layer, _FF_LAYERS) and in_type.kind == "cnn":
+            return CnnToFeedForwardPreProcessor()
+        return None
+
+    def build(self) -> "ComputationGraph":
+        if not self._inputs:
+            raise ValueError("graph has no inputs")
+        if not self._outputs:
+            raise ValueError("graph has no outputs (set_outputs)")
+        if len(self._input_types) != len(self._inputs):
+            raise ValueError(
+                f"{len(self._inputs)} inputs but {len(self._input_types)} input types declared"
+            )
+
+        known: Dict[str, InputType] = dict(zip(self._inputs, self._input_types))
+        # flat declared inputs consumed by ff layers act as plain feature vectors
+        specs: List[VertexSpec] = []
+        pending = list(self._nodes)
+        # topological resolve (nodes may be declared in any order)
+        progress = True
+        while pending and progress:
+            progress = False
+            remaining = []
+            for node in pending:
+                if all(i in known for i in node["inputs"]):
+                    specs.append(self._finalize_node(node, known))
+                    known[node["name"]] = specs[-1].out_type
+                    progress = True
+                else:
+                    remaining.append(node)
+            pending = remaining
+        if pending:
+            missing = {i for n in pending for i in n["inputs"] if i not in known}
+            raise ValueError(f"unresolvable graph: missing vertices {sorted(missing)}")
+
+        for o in self._outputs:
+            if o not in known:
+                raise ValueError(f"output {o!r} is not a graph vertex")
+
+        return ComputationGraph(
+            config=self.config,
+            input_names=tuple(self._inputs),
+            input_types=tuple(self._input_types),
+            vertices=tuple(specs),
+            output_names=tuple(self._outputs),
+        )
+
+    def _finalize_node(self, node: dict, known: Dict[str, InputType]) -> VertexSpec:
+        in_types = [known[i] for i in node["inputs"]]
+        if "vertex" in node:
+            vertex = node["vertex"]
+            return VertexSpec(
+                name=node["name"],
+                inputs=node["inputs"],
+                vertex=vertex,
+                in_type=in_types[0],
+                out_type=vertex.output_type(in_types),
+            )
+        layer = self._resolve_layer_defaults(node["layer"])
+        if len(in_types) != 1:
+            raise ValueError(f"layer {node['name']!r} must have exactly one input")
+        in_type = in_types[0]
+        pre = node.get("preprocessor") or self._auto_preprocessor(layer, in_type)
+        if pre is not None:
+            in_type = pre.output_type(in_type)
+        elif in_type.kind == "cnn_flat" and isinstance(layer, _FF_LAYERS):
+            in_type = InputType.feed_forward(in_type.features)
+        return VertexSpec(
+            name=node["name"],
+            inputs=node["inputs"],
+            layer=layer,
+            preprocessor=pre,
+            in_type=in_type,
+            out_type=layer.output_type(in_type),
+            raw_layer=node["layer"],
+        )
+
+
+class ComputationGraph:
+    """Immutable compiled graph topology + pure init/apply."""
+
+    def __init__(self, config, input_names, input_types, vertices, output_names):
+        self.config: GraphConfig = config
+        self.input_names: Tuple[str, ...] = input_names
+        self.input_types: Tuple[InputType, ...] = input_types
+        self.vertices: Tuple[VertexSpec, ...] = vertices
+        self.output_names: Tuple[str, ...] = output_names
+        self._by_name = {v.name: v for v in vertices}
+
+    # -- introspection ------------------------------------------------------
+    def vertex(self, name: str) -> VertexSpec:
+        return self._by_name[name]
+
+    def layer_names(self) -> List[str]:
+        return [v.name for v in self.vertices if v.layer is not None]
+
+    def layer_updaters(self) -> Dict[str, UpdaterSpec]:
+        """Per-layer updater specs for layers that own parameters (consumed by
+        GraphOptimizer — the reference's per-layer ``.updater(...)`` calls)."""
+        return {
+            v.name: v.layer.updater
+            for v in self.vertices
+            if v.layer is not None and v.layer.has_params()
+        }
+
+    def param_roles(self) -> Dict[str, Dict[str, str]]:
+        return {
+            v.name: v.layer.param_roles()
+            for v in self.vertices
+            if v.layer is not None and v.layer.has_params()
+        }
+
+    def output_layers(self) -> List[VertexSpec]:
+        return [
+            v
+            for v in self.vertices
+            if v.name in self.output_names and isinstance(v.layer, (OutputLayer, LossLayer))
+        ]
+
+    # -- init ---------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> Dict[str, Dict[str, jnp.ndarray]]:
+        """Initialize params deterministically from the config seed (the
+        reference seeds every graph with 666, dl4jGANComputerVision.java:121)."""
+        root = jax.random.PRNGKey(self.config.seed if seed is None else seed)
+        params: Dict[str, Dict[str, jnp.ndarray]] = {}
+        for idx, v in enumerate(self.vertices):
+            if v.layer is None or not v.layer.has_params():
+                continue
+            key = jax.random.fold_in(root, idx)
+            params[v.name] = v.layer.init(key, v.in_type)
+        return params
+
+    # -- forward ------------------------------------------------------------
+    def apply(
+        self,
+        params: Dict,
+        inputs: Union[jnp.ndarray, Dict[str, jnp.ndarray]],
+        *,
+        train: bool = False,
+        rng=None,
+    ):
+        """Feed-forward. Returns (outputs, new_params) where new_params carries
+        BN running-stat updates when train=True (identical tree otherwise)."""
+        if not isinstance(inputs, dict):
+            if len(self.input_names) != 1:
+                raise ValueError("graph has multiple inputs; pass a dict")
+            inputs = {self.input_names[0]: inputs}
+        acts: Dict[str, jnp.ndarray] = dict(inputs)
+        new_params = dict(params)
+        for idx, v in enumerate(self.vertices):
+            if v.vertex is not None:
+                acts[v.name] = v.vertex.apply([acts[i] for i in v.inputs])
+                continue
+            x = acts[v.inputs[0]]
+            if v.preprocessor is not None:
+                x = v.preprocessor(x)
+            layer_rng = None
+            if rng is not None:
+                layer_rng = jax.random.fold_in(rng, idx)
+            y, updates = v.layer.apply(
+                params.get(v.name, {}), x, train=train, rng=layer_rng
+            )
+            if updates:
+                new_params[v.name] = {**params[v.name], **updates}
+            acts[v.name] = y
+        outputs = {o: acts[o] for o in self.output_names}
+        return outputs, new_params
+
+    def output(self, params: Dict, inputs, *, train: bool = False):
+        """Inference convenience (DL4J ``graph.output(x)``): returns the single
+        output array, or a dict for multi-output graphs."""
+        outs, _ = self.apply(params, inputs, train=train)
+        if len(self.output_names) == 1:
+            return outs[self.output_names[0]]
+        return outs
+
+    # -- loss ---------------------------------------------------------------
+    def l2_penalty(self, params: Dict) -> jnp.ndarray:
+        """0.5 * l2 * ||W||² summed over weight-role params (DL4J L2 score
+        term; reference l2=1e-4, dl4jGANComputerVision.java:123)."""
+        total = jnp.zeros((), jnp.float32)
+        for v in self.vertices:
+            if v.layer is None or not v.layer.has_params():
+                continue
+            l2 = v.layer.l2 or 0.0
+            if l2 <= 0.0:
+                continue
+            roles = v.layer.param_roles()
+            for pname, role in roles.items():
+                if role == "weight":
+                    w = params[v.name][pname]
+                    total = total + 0.5 * l2 * jnp.sum(w.astype(jnp.float32) ** 2)
+        return total
+
+    def loss(self, params: Dict, inputs, labels, *, train: bool = True, rng=None):
+        """Total training loss: sum of output-layer losses + L2 penalty.
+        Returns (loss, (outputs, new_params))."""
+        outs, new_params = self.apply(params, inputs, train=train, rng=rng)
+        if not isinstance(labels, dict):
+            if len(self.output_names) != 1:
+                raise ValueError("graph has multiple outputs; pass labels as a dict")
+            labels = {self.output_names[0]: labels}
+        out_layers = self.output_layers()
+        if not out_layers:
+            raise ValueError("graph has no loss-bearing output layers")
+        total = jnp.zeros((), jnp.float32)
+        for v in out_layers:
+            total = total + v.layer.loss_fn(outs[v.name], labels[v.name])
+        total = total + self.l2_penalty(params)
+        return total, (outs, new_params)
+
+    # -- named-parameter protocol ------------------------------------------
+    @staticmethod
+    def get_param(params: Dict, layer: str, name: str) -> jnp.ndarray:
+        """DL4J ``graph.getLayer(l).getParam(n)``
+        (dl4jGANComputerVision.java:429-542)."""
+        return params[layer][name]
+
+    @staticmethod
+    def set_param(params: Dict, layer: str, name: str, value) -> Dict:
+        """Functional DL4J ``setParam``: returns a new params tree."""
+        if layer not in params:
+            raise KeyError(f"unknown layer {layer!r}")
+        if name not in params[layer]:
+            raise KeyError(f"layer {layer!r} has no param {name!r}")
+        if tuple(params[layer][name].shape) != tuple(value.shape):
+            raise ValueError(
+                f"shape mismatch setting {layer}/{name}: "
+                f"{params[layer][name].shape} vs {value.shape}"
+            )
+        new_layer = {**params[layer], name: value}
+        return {**params, layer: new_layer}
+
+    @staticmethod
+    def copy_params(src_params: Dict, dst_params: Dict, mapping: Dict[str, str]) -> Dict:
+        """Bulk named-parameter copy — the reference's weight-sync protocol
+        (12 dis→gan, 16 gan→gen, 10 dis→CV copies per iteration,
+        dl4jGANComputerVision.java:429-542) as one functional op. ``mapping``
+        is {src_layer: dst_layer}; all params of each layer are copied."""
+        out = dict(dst_params)
+        for src_layer, dst_layer in mapping.items():
+            if src_layer not in src_params:
+                raise KeyError(f"source layer {src_layer!r} not in params")
+            if dst_layer not in out:
+                raise KeyError(f"dest layer {dst_layer!r} not in params")
+            for pname, value in src_params[src_layer].items():
+                if pname not in out[dst_layer]:
+                    raise KeyError(f"dest layer {dst_layer!r} has no param {pname!r}")
+                if tuple(out[dst_layer][pname].shape) != tuple(value.shape):
+                    raise ValueError(
+                        f"shape mismatch copying {src_layer}/{pname} -> {dst_layer}: "
+                        f"{value.shape} vs {out[dst_layer][pname].shape}"
+                    )
+            out[dst_layer] = {**out[dst_layer], **dict(src_params[src_layer])}
+        return out
+
+    # -- reporting ----------------------------------------------------------
+    def param_count(self, params: Optional[Dict] = None) -> int:
+        params = params if params is not None else self.init()
+        return sum(int(p.size) for lp in params.values() for p in lp.values())
+
+    def summary(self, params: Optional[Dict] = None) -> str:
+        """DL4J ``graph.summary()`` analog (printed by the reference after
+        every build, dl4jGANComputerVision.java:167,223,312,365)."""
+        params = params if params is not None else self.init()
+        rows = [("Name (type)", "In", "Out", "# Params")]
+        for name, t in zip(self.input_names, self.input_types):
+            rows.append((f"{name} (Input)", "-", str(t), "0"))
+        total = 0
+        for v in self.vertices:
+            kind = v.layer.kind if v.layer is not None else type(v.vertex).__name__
+            n = sum(int(p.size) for p in params.get(v.name, {}).values())
+            total += n
+            pre = f" [+{type(v.preprocessor).__name__}]" if v.preprocessor is not None else ""
+            rows.append((f"{v.name} ({kind}){pre}", str(v.in_type), str(v.out_type), str(n)))
+        widths = [max(len(r[i]) for r in rows) for i in range(4)]
+        lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip() for r in rows]
+        lines.insert(1, "-" * (sum(widths) + 6))
+        lines.append("-" * (sum(widths) + 6))
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict:
+        nodes = []
+        for v in self.vertices:
+            node = {"name": v.name, "inputs": list(v.inputs)}
+            if v.layer is not None:
+                node["layer"] = v.layer.to_dict()
+                if v.preprocessor is not None:
+                    node["preprocessor"] = v.preprocessor.to_dict()
+            else:
+                node["vertex"] = v.vertex.to_dict()
+            nodes.append(node)
+        return {
+            "config": self.config.to_dict(),
+            "inputs": list(self.input_names),
+            "input_types": [t.to_dict() for t in self.input_types],
+            "nodes": nodes,
+            "outputs": list(self.output_names),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ComputationGraph":
+        builder = GraphBuilder(GraphConfig.from_dict(d["config"]))
+        builder.add_inputs(*d["inputs"])
+        builder.set_input_types(*[InputType.from_dict(t) for t in d["input_types"]])
+        for node in d["nodes"]:
+            if "layer" in node:
+                pre = (
+                    preprocessor_from_dict(node["preprocessor"])
+                    if "preprocessor" in node
+                    else None
+                )
+                builder.add_layer(
+                    node["name"], layer_from_dict(node["layer"]), *node["inputs"],
+                    preprocessor=pre,
+                )
+            else:
+                builder.add_vertex(node["name"], _vertex_from_dict(node["vertex"]), *node["inputs"])
+        builder.set_outputs(*d["outputs"])
+        return builder.build()

@@ -1,0 +1,369 @@
+"""Layer zoo — the layers the reference exercises plus the few extras its
+wider configs need (SURVEY §2.2 D7).
+
+Each layer is a frozen config dataclass with pure ``init``/``apply``:
+
+- ``init(key, in_type) -> params``: a flat dict of named arrays. Names match
+  DL4J's (``W``/``b``; BatchNorm ``gamma``/``beta``/``mean``/``var``) because
+  the reference's weight-sync protocol addresses params by
+  (layer, name) — dl4jGANComputerVision.java:429-542.
+- ``apply(params, x, train, rng) -> (y, state_updates)``: ``state_updates`` is
+  a dict of non-trainable params rewritten during the training forward pass
+  (BatchNorm running stats) or None.
+- ``output_type(in_type)``: shape inference for GraphBuilder.
+- ``param_roles()``: name -> role ("weight" | "bias" | "state"); L2 applies to
+  weights only, updaters skip "state".
+
+All compute dispatches to the functional ops layer (XLA→MXU), never inline
+math, so pallas/XLA-level optimization happens in exactly one place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.nn.input_type import InputType
+from gan_deeplearning4j_tpu.ops import activations as act_ops
+from gan_deeplearning4j_tpu.ops import conv as conv_ops
+from gan_deeplearning4j_tpu.ops import initializers as init_ops
+from gan_deeplearning4j_tpu.ops import linear as linear_ops
+from gan_deeplearning4j_tpu.ops import losses as loss_ops
+from gan_deeplearning4j_tpu.ops import norm as norm_ops
+from gan_deeplearning4j_tpu.optim.updaters import UpdaterSpec, updater_from_dict
+from gan_deeplearning4j_tpu.runtime.dtype import get_default_dtype
+
+IntPair = Union[int, Tuple[int, int]]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    a, b = v
+    return (int(a), int(b))
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """Base layer config. ``activation``/``weight_init``/``updater``/``l2`` of
+    None mean "inherit the graph default" (resolved by GraphBuilder, matching
+    DL4J's NeuralNetConfiguration defaulting)."""
+
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    updater: Optional[UpdaterSpec] = None
+    l2: Optional[float] = None
+
+    # -- to be implemented by subclasses -----------------------------------
+    def init(self, key, in_type: InputType) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        raise NotImplementedError
+
+    def output_type(self, in_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def param_roles(self) -> Dict[str, str]:
+        return {}
+
+    # -- common helpers -----------------------------------------------------
+    @property
+    def kind(self) -> str:
+        return type(self).__name__
+
+    def _act(self, x):
+        return act_ops.get(self.activation or "identity")(x)
+
+    def has_params(self) -> bool:
+        return bool(self.param_roles())
+
+    def to_dict(self) -> dict:
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, UpdaterSpec):
+                v = v.to_dict()
+            d[f.name] = v
+        d["type"] = self.kind
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseLayer(Layer):
+    """Fully-connected layer (DL4J DenseLayer; e.g.
+    dl4jGANComputerVision.java:155-158 dense 1024)."""
+
+    n_out: int = 0
+    n_in: Optional[int] = None  # inferred from in_type when None
+
+    def _n_in(self, in_type: InputType) -> int:
+        return self.n_in if self.n_in is not None else in_type.features
+
+    def init(self, key, in_type):
+        n_in = self._n_in(in_type)
+        kw, _ = jax.random.split(key)
+        w = init_ops.get(self.weight_init or "xavier")(kw, (n_in, self.n_out), get_default_dtype())
+        b = jnp.zeros((self.n_out,), get_default_dtype())
+        return {"W": w, "b": b}
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        return self._act(linear_ops.dense(x, params["W"], params["b"])), None
+
+    def output_type(self, in_type):
+        return InputType.feed_forward(self.n_out)
+
+    def param_roles(self):
+        return {"W": "weight", "b": "bias"}
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputLayer(DenseLayer):
+    """Dense + attached loss (DL4J OutputLayer: XENT sigmoid at
+    dl4jGANComputerVision.java:159-162, MCXENT softmax at :358-362)."""
+
+    loss: str = "xent"
+
+    def loss_fn(self, probs, labels):
+        return loss_ops.get(self.loss)(probs, labels)
+
+
+@dataclasses.dataclass(frozen=True)
+class LossLayer(Layer):
+    """Parameterless loss attachment (for WGAN critics etc.): passes input
+    through an activation and binds a loss."""
+
+    loss: str = "mse"
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        return self._act(x), None
+
+    def output_type(self, in_type):
+        return in_type
+
+    def loss_fn(self, preds, labels):
+        return loss_ops.get(self.loss)(preds, labels)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchNormalization(Layer):
+    """BatchNorm over the trailing feature/channel axis (DL4J
+    BatchNormalization; dis at dl4jGANComputerVision.java:132-135, gen at
+    :186,197-199). Running ``mean``/``var`` are named params with role
+    "state" — updated by the training forward pass and copied between graphs
+    by name in the reference's sync protocol (:437-440,498-500,523-527)."""
+
+    decay: float = norm_ops.DEFAULT_DECAY
+    eps: float = norm_ops.DEFAULT_EPS
+
+    @staticmethod
+    def _n_features(in_type: InputType) -> int:
+        return in_type.shape[-1] if in_type.kind == "cnn" else in_type.features
+
+    def init(self, key, in_type):
+        n = self._n_features(in_type)
+        dt = get_default_dtype()
+        return {
+            "gamma": jnp.ones((n,), dt),
+            "beta": jnp.zeros((n,), dt),
+            "mean": jnp.zeros((n,), dt),
+            "var": jnp.ones((n,), dt),
+        }
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        if train:
+            y, new_mean, new_var = norm_ops.batch_norm_train(
+                x, params["gamma"], params["beta"], params["mean"], params["var"],
+                eps=self.eps, decay=self.decay,
+            )
+            return self._act(y), {"mean": new_mean, "var": new_var}
+        y = norm_ops.batch_norm_inference(
+            x, params["gamma"], params["beta"], params["mean"], params["var"], eps=self.eps
+        )
+        return self._act(y), None
+
+    def output_type(self, in_type):
+        return in_type
+
+    def param_roles(self):
+        # DL4J applies no L2 to BN gamma/beta; roles "gain"/"bias" are exempt
+        return {"gamma": "gain", "beta": "bias", "mean": "state", "var": "state"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvolutionLayer(Layer):
+    """2-D convolution (DL4J ConvolutionLayer; 5x5 s2 at
+    dl4jGANComputerVision.java:136-139, 5x5 s1 p2 at :207-213). Kernel stored
+    HWIO; shape semantics = DL4J Truncate mode."""
+
+    kernel: IntPair = 5
+    stride: IntPair = 1
+    padding: IntPair = 0
+    n_out: int = 0
+    n_in: Optional[int] = None
+
+    def _n_in(self, in_type: InputType) -> int:
+        return self.n_in if self.n_in is not None else in_type.channels
+
+    def init(self, key, in_type):
+        kh, kw = _pair(self.kernel)
+        n_in = self._n_in(in_type)
+        wkey, _ = jax.random.split(key)
+        w = init_ops.get(self.weight_init or "xavier")(
+            wkey, (kh, kw, n_in, self.n_out), get_default_dtype()
+        )
+        b = jnp.zeros((self.n_out,), get_default_dtype())
+        return {"W": w, "b": b}
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        y = conv_ops.conv2d(x, params["W"], params["b"], stride=self.stride, padding=self.padding)
+        return self._act(y), None
+
+    def output_type(self, in_type):
+        h, w, _ = in_type.shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        return InputType.convolutional(
+            conv_ops.conv_out_size(h, kh, sh, ph),
+            conv_ops.conv_out_size(w, kw, sw, pw),
+            self.n_out,
+        )
+
+    def param_roles(self):
+        return {"W": "weight", "b": "bias"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution (DL4J Deconvolution2D — unused by the reference
+    graphs but part of the DL4J layer surface and the BASELINE.md CIFAR/CelebA
+    configs)."""
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        y = conv_ops.conv2d_transpose(
+            x, params["W"], params["b"], stride=self.stride, padding=self.padding
+        )
+        return self._act(y), None
+
+    def output_type(self, in_type):
+        h, w, _ = in_type.shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        return InputType.convolutional(
+            (h - 1) * sh - 2 * ph + kh,
+            (w - 1) * sw - 2 * pw + kw,
+            self.n_out,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class SubsamplingLayer(Layer):
+    """Pooling (DL4J SubsamplingLayer MAX 2x2 s1,
+    dl4jGANComputerVision.java:140-143,150-154)."""
+
+    pool: str = "max"
+    kernel: IntPair = 2
+    stride: IntPair = 2
+    padding: IntPair = 0
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        if self.pool == "max":
+            y = conv_ops.max_pool2d(x, kernel=self.kernel, stride=self.stride, padding=self.padding)
+        elif self.pool == "avg":
+            y = conv_ops.avg_pool2d(x, kernel=self.kernel, stride=self.stride, padding=self.padding)
+        else:
+            raise ValueError(f"unknown pool type {self.pool!r}")
+        return self._act(y), None
+
+    def output_type(self, in_type):
+        h, w, c = in_type.shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        ph, pw = _pair(self.padding)
+        return InputType.convolutional(
+            conv_ops.conv_out_size(h, kh, sh, ph),
+            conv_ops.conv_out_size(w, kw, sw, pw),
+            c,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Upsampling2D(Layer):
+    """Nearest-neighbor upsampling (DL4J Upsampling2D,
+    dl4jGANComputerVision.java:201-206)."""
+
+    size: IntPair = 2
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        return conv_ops.upsample2d(x, scale=self.size), None
+
+    def output_type(self, in_type):
+        h, w, c = in_type.shape
+        sh, sw = _pair(self.size)
+        return InputType.convolutional(h * sh, w * sw, c)
+
+
+@dataclasses.dataclass(frozen=True)
+class ActivationLayer(Layer):
+    """Standalone activation."""
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        return self._act(x), None
+
+    def output_type(self, in_type):
+        return in_type
+
+
+@dataclasses.dataclass(frozen=True)
+class DropoutLayer(Layer):
+    """Inverted dropout (train-only; DL4J semantics — unused by the reference
+    graphs, part of the wider surface)."""
+
+    rate: float = 0.5
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        if not train or self.rate <= 0.0:
+            return x, None
+        if rng is None:
+            raise ValueError("DropoutLayer needs an rng key when train=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0), None
+
+    def output_type(self, in_type):
+        return in_type
+
+
+_LAYER_CLASSES = {
+    c.__name__: c
+    for c in (
+        DenseLayer,
+        OutputLayer,
+        LossLayer,
+        BatchNormalization,
+        ConvolutionLayer,
+        Deconvolution2D,
+        SubsamplingLayer,
+        Upsampling2D,
+        ActivationLayer,
+        DropoutLayer,
+    )
+}
+
+
+def layer_from_dict(d: dict) -> Layer:
+    d = dict(d)
+    kind = d.pop("type")
+    if kind not in _LAYER_CLASSES:
+        raise KeyError(f"unknown layer type {kind!r}")
+    if d.get("updater") is not None:
+        d["updater"] = updater_from_dict(d["updater"])
+    for k in ("kernel", "stride", "padding", "size"):
+        if isinstance(d.get(k), list):
+            d[k] = tuple(d[k])
+    return _LAYER_CLASSES[kind](**d)

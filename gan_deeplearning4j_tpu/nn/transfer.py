@@ -1,0 +1,183 @@
+"""Transfer-learning graph surgery (SURVEY §2.2 D11).
+
+The reference builds its classifier from the trained discriminator with DL4J's
+``TransferLearning.GraphBuilder`` (dl4jGANComputerVision.java:337-364):
+
+- ``fineTuneConfiguration`` re-applies the common hyperparams with a fresh
+  updater (:338-350);
+- ``setFeatureExtractor("dis_dense_layer_6")`` freezes everything up to and
+  including that vertex (:352) — in this framework, as in the reference's own
+  freezing mechanism, "frozen" = updater learning rate 0.0 (:84,187,277);
+- ``removeVertexAndConnections``/``removeVertexKeepConnections`` drops the old
+  output head (:353);
+- ``addLayer`` appends the new head (:354-363).
+
+The builder is functional: ``build()`` returns a new (graph, params) pair;
+retained layers carry their trained parameters over, new layers are freshly
+initialized from the fine-tune seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+from gan_deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder, GraphConfig
+from gan_deeplearning4j_tpu.nn.layers import Layer
+from gan_deeplearning4j_tpu.optim.updaters import UpdaterSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class FineTuneConfiguration:
+    """Global training-config override applied to the surgered graph (DL4J
+    FineTuneConfiguration, dl4jGANComputerVision.java:338-350). ``None`` fields
+    keep the source graph's values."""
+
+    seed: Optional[int] = None
+    default_activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    l2: Optional[float] = None
+    gradient_clip: Optional[str] = None
+    gradient_clip_value: Optional[float] = None
+    updater: Optional[UpdaterSpec] = None
+    optimization_algo: Optional[str] = None
+
+    def apply_to(self, config: GraphConfig) -> GraphConfig:
+        updates = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if getattr(self, f.name) is not None
+        }
+        return dataclasses.replace(config, **updates)
+
+
+class TransferLearning:
+    """DL4J ``TransferLearning.GraphBuilder`` analog, functional."""
+
+    def __init__(self, graph: ComputationGraph, params: Dict):
+        self._graph = graph
+        self._params = params
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[str] = None
+        self._removed: List[str] = []
+        self._added: List[dict] = []
+        self._new_outputs: Optional[List[str]] = None
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration) -> "TransferLearning":
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, vertex_name: str) -> "TransferLearning":
+        """Freeze all layers up to and including ``vertex_name`` (LR→0.0)."""
+        if vertex_name not in {v.name for v in self._graph.vertices}:
+            raise KeyError(f"unknown vertex {vertex_name!r}")
+        self._freeze_until = vertex_name
+        return self
+
+    def remove_vertex_keep_connections(self, name: str) -> "TransferLearning":
+        """Drop a vertex, splicing its inputs into its consumers (DL4J
+        ``removeVertexKeepConnections``): anything that consumed the removed
+        vertex consumes its inputs instead. The reference uses it to drop the
+        old output head before appending a new one (:353-363)."""
+        if name not in {v.name for v in self._graph.vertices}:
+            raise KeyError(f"unknown vertex {name!r}")
+        self._removed.append(name)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str) -> "TransferLearning":
+        self._added.append({"name": name, "layer": layer, "inputs": tuple(inputs)})
+        return self
+
+    def set_outputs(self, *names: str) -> "TransferLearning":
+        self._new_outputs = list(names)
+        return self
+
+    def build(self) -> Tuple[ComputationGraph, Dict]:
+        src = self._graph
+        config = src.config
+        if self._fine_tune is not None:
+            config = self._fine_tune.apply_to(config)
+
+        # layers frozen = all vertices in topo order up to freeze_until
+        frozen = set()
+        if self._freeze_until is not None:
+            for v in src.vertices:
+                frozen.add(v.name)
+                if v.name == self._freeze_until:
+                    break
+
+        # removed vertices are spliced out: consumers inherit their inputs
+        splice = {
+            v.name: list(v.inputs) for v in src.vertices if v.name in self._removed
+        }
+
+        def rewire(inputs):
+            out: List[str] = []
+            for i in inputs:
+                if i in splice:
+                    out.extend(rewire(splice[i]))
+                else:
+                    out.append(i)
+            return tuple(out)
+
+        builder = GraphBuilder(config)
+        builder.add_inputs(*src.input_names)
+        builder.set_input_types(*src.input_types)
+        kept: List[str] = []
+        for v in src.vertices:
+            if v.name in self._removed:
+                continue
+            inputs = rewire(v.inputs)
+            if v.vertex is not None:
+                builder.add_vertex(v.name, v.vertex, *inputs)
+                continue
+            # re-resolve inherited (None) fields against the fine-tuned config,
+            # and — DL4J FineTuneConfiguration semantics — let explicitly set
+            # fine-tune values override retained non-frozen layers' own
+            # updater/l2 (activation/weight_init act as defaults only).
+            layer = v.raw_layer if v.raw_layer is not None else v.layer
+            if v.name in frozen and v.layer.has_params():
+                # freeze = resolved updater with LR 0.0 (reference :84)
+                layer = dataclasses.replace(
+                    layer, updater=v.layer.updater.with_learning_rate(0.0)
+                )
+            elif self._fine_tune is not None:
+                overrides = {}
+                if self._fine_tune.updater is not None:
+                    overrides["updater"] = self._fine_tune.updater
+                if self._fine_tune.l2 is not None:
+                    overrides["l2"] = self._fine_tune.l2
+                if overrides:
+                    layer = dataclasses.replace(layer, **overrides)
+            builder.add_layer(v.name, layer, *inputs, preprocessor=v.preprocessor)
+            kept.append(v.name)
+        for node in self._added:
+            builder.add_layer(node["name"], node["layer"], *node["inputs"])
+
+        outputs = self._new_outputs
+        if outputs is None:
+            # keep surviving outputs; if the old head was removed, the last
+            # added layer becomes the output (reference behavior: new head)
+            outputs = [o for o in src.output_names if o not in self._removed]
+            if self._added:
+                outputs = outputs + [self._added[-1]["name"]]
+            if not outputs:
+                raise ValueError("no outputs survive surgery; call set_outputs")
+        builder.set_outputs(*outputs)
+        new_graph = builder.build()
+
+        # params: carry over retained layers, init only the genuinely new ones
+        new_params = {}
+        for idx, v in enumerate(new_graph.vertices):
+            if v.layer is None or not v.layer.has_params():
+                continue
+            if v.name in self._params and v.name in kept:
+                new_params[v.name] = dict(self._params[v.name])
+            else:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(new_graph.config.seed), idx
+                )
+                new_params[v.name] = v.layer.init(key, v.in_type)
+        return new_graph, new_params

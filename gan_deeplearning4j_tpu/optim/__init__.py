@@ -1,0 +1,22 @@
+"""Optimizers — per-layer updaters with DL4J-parity semantics (SURVEY §2.2 D8-D9).
+
+The reference attaches ``new RmsProp(lr, 1e-8, 1e-8)`` to every layer
+individually, uses learning-rate 0.0 as the freezing mechanism
+(dl4jGANComputerVision.java:84,187,277), clips gradients elementwise at 1.0 and
+applies L2 1e-4 — all reproduced here, with updater state shaped like the param
+tree so it checkpoints alongside params (ModelSerializer saveUpdater analog,
+:605-619).
+"""
+
+from gan_deeplearning4j_tpu.optim.updaters import Adam, NoOp, RmsProp, Sgd, UpdaterSpec, updater_from_dict
+from gan_deeplearning4j_tpu.optim.optimizer import GraphOptimizer
+
+__all__ = [
+    "UpdaterSpec",
+    "RmsProp",
+    "Sgd",
+    "Adam",
+    "NoOp",
+    "updater_from_dict",
+    "GraphOptimizer",
+]

@@ -1,0 +1,126 @@
+"""Updater specs + update rules (DL4J parity).
+
+``RmsProp(lr, rmsDecay, epsilon)`` matches DL4J's RmsPropUpdater:
+
+    cache ← decay * cache + (1 - decay) * g²      (cache initialized to eps)
+    Δ     = lr * g / sqrt(cache + eps)
+
+The reference instantiates it with decay = eps = 1e-8
+(dl4jGANComputerVision.java:133,187,242 et al.), making cache ≈ g² and the
+update ≈ lr·sign(g) — SURVEY §7 calls out that this near-sign-SGD behavior must
+be reproduced faithfully, not replaced by a library default (optax's rmsprop
+keeps a long-decay moving average; at decay 1e-8 the DL4J rule is a different
+optimizer in practice).
+
+Learning rate 0.0 is the freezing mechanism (:84): the update is exactly zero
+but state still advances, matching DL4J (frozen layers' updater state is still
+serialized and copied around).
+
+Updaters are *specs* (hashable config); state creation and application are pure
+functions so the whole optimizer step jits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdaterSpec:
+    learning_rate: float = 0.0
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__.lower()
+
+    def init_state(self, param) -> Dict[str, Any]:
+        return {}
+
+    def apply(self, state: Dict[str, Any], grad, param) -> Tuple[Any, Dict[str, Any]]:
+        """Returns (delta_to_subtract, new_state)."""
+        raise NotImplementedError
+
+    def with_learning_rate(self, lr: float) -> "UpdaterSpec":
+        return dataclasses.replace(self, learning_rate=lr)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["type"] = self.kind
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class Sgd(UpdaterSpec):
+    learning_rate: float = 0.01
+
+    def apply(self, state, grad, param):
+        del param
+        return self.learning_rate * grad, state
+
+
+@dataclasses.dataclass(frozen=True)
+class NoOp(UpdaterSpec):
+    """Never updates (hard-freeze alternative to lr=0)."""
+
+    def apply(self, state, grad, param):
+        return jnp.zeros_like(param), state
+
+
+@dataclasses.dataclass(frozen=True)
+class RmsProp(UpdaterSpec):
+    """DL4J RmsPropUpdater. Reference config: RmsProp(lr, 1e-8, 1e-8)."""
+
+    learning_rate: float = 0.001
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init_state(self, param):
+        # DL4J initializes the cache to epsilon (avoids div-by-zero on step 1)
+        return {"cache": jnp.full_like(param, self.epsilon)}
+
+    def apply(self, state, grad, param):
+        del param
+        cache = state["cache"] * self.rms_decay + (grad**2) * (1.0 - self.rms_decay)
+        delta = grad * self.learning_rate / jnp.sqrt(cache + self.epsilon)
+        return delta, {"cache": cache}
+
+
+@dataclasses.dataclass(frozen=True)
+class Adam(UpdaterSpec):
+    """Adam (named in the BASELINE.json north star; unused by the reference's
+    own graphs, which are RmsProp-only — provided for the wider configs)."""
+
+    learning_rate: float = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, param):
+        return {
+            "m": jnp.zeros_like(param),
+            "v": jnp.zeros_like(param),
+            "t": jnp.zeros((), jnp.int32),
+        }
+
+    def apply(self, state, grad, param):
+        del param
+        t = state["t"] + 1
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad**2
+        tf = t.astype(jnp.float32)
+        m_hat = m / (1 - self.beta1**tf)
+        v_hat = v / (1 - self.beta2**tf)
+        delta = self.learning_rate * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        return delta, {"m": m, "v": v, "t": t}
+
+
+def updater_from_dict(d: dict) -> UpdaterSpec:
+    d = dict(d)
+    kind = d.pop("type")
+    classes = {"sgd": Sgd, "noop": NoOp, "rmsprop": RmsProp, "adam": Adam}
+    if kind not in classes:
+        raise KeyError(f"unknown updater type {kind!r}")
+    return classes[kind](**d)

@@ -23,7 +23,8 @@ class RngStream:
 
     def __init__(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(self._seed)
+        self._initial_key = jax.random.PRNGKey(self._seed)
+        self._key = self._initial_key
 
     @property
     def seed(self) -> int:
@@ -38,10 +39,12 @@ class RngStream:
         return list(subs)
 
     def fork(self) -> "RngStream":
-        """A new independent stream (seeded from this one's next key)."""
+        """A new independent stream rooted at this one's next key; the child's
+        ``reset`` rewinds to its own root, not the parent's."""
         child = RngStream(self._seed)
-        child._key = self.next_key()
+        child._initial_key = self.next_key()
+        child._key = child._initial_key
         return child
 
     def reset(self) -> None:
-        self._key = jax.random.PRNGKey(self._seed)
+        self._key = self._initial_key

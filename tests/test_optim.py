@@ -1,0 +1,166 @@
+"""Optimizer tests: DL4J RmsProp rule parity, LR-0 freezing, per-layer
+updaters, clipping integration, and end-to-end convergence on a toy problem."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.nn import (
+    BatchNormalization,
+    DenseLayer,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.optim import Adam, GraphOptimizer, NoOp, RmsProp, Sgd
+from gan_deeplearning4j_tpu.optim.updaters import updater_from_dict
+
+
+class TestRmsPropRule:
+    def test_matches_dl4j_formula(self):
+        """cache ← d*cache + (1-d)*g² (cache₀=eps); Δ = lr*g/sqrt(cache+eps)."""
+        up = RmsProp(learning_rate=0.01, rms_decay=0.95, epsilon=1e-8)
+        p = jnp.array([1.0, 2.0])
+        g = jnp.array([0.5, -0.3])
+        state = up.init_state(p)
+        np.testing.assert_allclose(np.asarray(state["cache"]), [1e-8, 1e-8])
+        delta, new_state = up.apply(state, g, p)
+        cache = 1e-8 * 0.95 + np.array([0.25, 0.09]) * 0.05
+        expect = 0.01 * np.array([0.5, -0.3]) / np.sqrt(cache + 1e-8)
+        np.testing.assert_allclose(np.asarray(delta), expect, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(new_state["cache"]), cache, rtol=1e-6)
+
+    def test_reference_constants_approx_sign_sgd(self):
+        """With decay=eps=1e-8 (the reference's constants) the first update is
+        ≈ lr·sign(g) — SURVEY §7's 'near-sign-SGD' behavior."""
+        up = RmsProp(learning_rate=0.002, rms_decay=1e-8, epsilon=1e-8)
+        p = jnp.zeros(3)
+        g = jnp.array([10.0, -0.01, 0.5])
+        delta, _ = up.apply(up.init_state(p), g, p)
+        np.testing.assert_allclose(np.asarray(delta), 0.002 * np.sign(np.asarray(g)), rtol=1e-2)
+
+    def test_lr_zero_freezes_but_state_advances(self):
+        up = RmsProp(learning_rate=0.0, rms_decay=1e-8, epsilon=1e-8)
+        p = jnp.ones(2)
+        g = jnp.ones(2)
+        state = up.init_state(p)
+        delta, new_state = up.apply(state, g, p)
+        np.testing.assert_array_equal(np.asarray(delta), [0.0, 0.0])
+        assert not np.array_equal(np.asarray(new_state["cache"]), np.asarray(state["cache"]))
+
+
+class TestOtherUpdaters:
+    def test_sgd(self):
+        delta, _ = Sgd(0.1).apply({}, jnp.array([1.0, -2.0]), None)
+        np.testing.assert_allclose(np.asarray(delta), [0.1, -0.2])
+
+    def test_noop(self):
+        p = jnp.ones(3)
+        delta, _ = NoOp().apply({}, jnp.ones(3), p)
+        np.testing.assert_array_equal(np.asarray(delta), np.zeros(3))
+
+    def test_adam_first_step(self):
+        up = Adam(learning_rate=0.1)
+        p = jnp.zeros(1)
+        g = jnp.array([0.5])
+        delta, state = up.apply(up.init_state(p), g, p)
+        # bias-corrected first step ≈ lr * sign(g)
+        np.testing.assert_allclose(np.asarray(delta), [0.1], rtol=1e-4)
+        assert int(state["t"]) == 1
+
+    def test_serialization_roundtrip(self):
+        for up in (RmsProp(0.002, 1e-8, 1e-8), Sgd(0.1), Adam(0.001), NoOp()):
+            assert updater_from_dict(up.to_dict()) == up
+
+
+def two_layer_graph(l2=0.0, clip=None):
+    cfg = GraphConfig(
+        seed=3, l2=l2, gradient_clip=clip, gradient_clip_value=1.0, updater=Sgd(0.5)
+    )
+    b = GraphBuilder(cfg)
+    b.add_inputs("in")
+    b.set_input_types(InputType.feed_forward(2))
+    b.add_layer("bn", BatchNormalization(updater=Sgd(0.5)), "in")
+    b.add_layer("frozen", DenseLayer(n_out=3, updater=RmsProp(0.0, 1e-8, 1e-8)), "bn")
+    b.add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "frozen")
+    b.set_outputs("out")
+    return b.build()
+
+
+class TestGraphOptimizer:
+    def test_freezing_and_state_params(self):
+        g = two_layer_graph()
+        opt = GraphOptimizer(g)
+        params = g.init()
+        opt_state = opt.init(params)
+        # BN mean/var are state: no updater entries
+        assert "mean" not in opt_state["bn"] and "gamma" in opt_state["bn"]
+        # pooling-style layers without params absent entirely
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 2))
+        labels = jax.nn.one_hot(jnp.array([0, 1] * 4), 2)
+
+        def loss_fn(p):
+            l, (outs, new_p) = g.loss(p, x, labels, train=True)
+            return l, new_p
+
+        grads, new_p = jax.grad(loss_fn, has_aux=True)(params)
+        updated, new_state = opt.step(new_p, grads, opt_state)
+        # frozen layer unchanged
+        np.testing.assert_array_equal(
+            np.asarray(updated["frozen"]["W"]), np.asarray(params["frozen"]["W"])
+        )
+        # trainable layer moved
+        assert not np.array_equal(np.asarray(updated["out"]["W"]), np.asarray(params["out"]["W"]))
+        # BN stats came from forward pass, not optimizer
+        assert not np.array_equal(np.asarray(updated["bn"]["mean"]), np.asarray(params["bn"]["mean"]))
+
+    def test_elementwise_clip_bounds_update(self):
+        g = two_layer_graph(clip="elementwise")
+        opt = GraphOptimizer(g)
+        params = g.init()
+        opt_state = opt.init(params)
+        # fabricate a huge gradient for 'out' W with plain Sgd(0.5): update must be ≤ 0.5
+        grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        grads["out"]["W"] = jnp.full_like(params["out"]["W"], 1e6)
+        updated, _ = opt.step(params, grads, opt_state)
+        diff = np.abs(np.asarray(updated["out"]["W"] - params["out"]["W"]))
+        np.testing.assert_allclose(diff.max(), 0.5, rtol=1e-6)
+
+    def test_jit_and_convergence(self):
+        """A jitted graph-loss + optimizer step drives a small classifier to
+        near-zero loss — the full train-step path works under XLA."""
+        cfg = GraphConfig(seed=0, updater=RmsProp(0.01, 0.95, 1e-8))
+        b = GraphBuilder(cfg)
+        b.add_inputs("in")
+        b.set_input_types(InputType.feed_forward(2))
+        b.add_layer("h", DenseLayer(n_out=16, activation="tanh"), "in")
+        b.add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "h")
+        b.set_outputs("out")
+        g = b.build()
+        opt = GraphOptimizer(g)
+        params = g.init()
+        opt_state = opt.init(params)
+
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (64, 2))
+        y = (x[:, 0] > 0).astype(jnp.int32)
+        labels = jax.nn.one_hot(y, 2)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                l, (outs, new_p) = g.loss(p, x, labels, train=True)
+                return l, new_p
+
+            (loss, new_p), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            new_params, new_opt = opt.step(new_p, grads, opt_state)
+            return new_params, new_opt, loss
+
+        first = None
+        for i in range(150):
+            params, opt_state, loss = step(params, opt_state)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < 0.1 < first
