@@ -1,0 +1,42 @@
+"""Data layer — TPU-native DataVec equivalent (SURVEY §2.2 D13-D14, layer H).
+
+The reference's pipeline is CSV → ``CSVRecordReader`` → ``FileSplit`` →
+``RecordReaderDataSetIterator(batch, labelIndex=784, numClasses=10)`` →
+``DataSet{features, one-hot labels}`` (dl4jGANComputerVision.java:372-377,
+395-400). This package provides the same capability surface with device
+residency as the design goal: batches land in TPU HBM once and stay there.
+"""
+
+from gan_deeplearning4j_tpu.data.dataset import DataSet
+from gan_deeplearning4j_tpu.data.records import (
+    ClassPathResource,
+    CSVRecordReader,
+    FileSplit,
+    InMemoryRecordReader,
+)
+from gan_deeplearning4j_tpu.data.iterator import (
+    ArrayDataSetIterator,
+    DataSetIterator,
+    DevicePrefetchIterator,
+    RecordReaderDataSetIterator,
+)
+from gan_deeplearning4j_tpu.data.mnist import (
+    load_mnist_csv,
+    synthetic_mnist,
+    write_mnist_csv,
+)
+
+__all__ = [
+    "DataSet",
+    "ClassPathResource",
+    "CSVRecordReader",
+    "FileSplit",
+    "InMemoryRecordReader",
+    "ArrayDataSetIterator",
+    "DataSetIterator",
+    "DevicePrefetchIterator",
+    "RecordReaderDataSetIterator",
+    "load_mnist_csv",
+    "synthetic_mnist",
+    "write_mnist_csv",
+]

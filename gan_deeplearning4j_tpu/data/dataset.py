@@ -1,0 +1,112 @@
+"""DataSet — the (features, labels) batch value type (SURVEY §2.2 D14).
+
+The reference ships ``org.nd4j.linalg.dataset.DataSet`` objects between Spark
+workers with Kryo serialization (dl4jGANComputerVision.java:320-321,414-421).
+On TPU there is one process and batches are jax Arrays, so the serialization
+concern disappears; DataSet remains as the typed batch struct the trainer and
+iterators exchange. It is registered as a pytree so it can cross jit/shard_map
+boundaries and be sharded over the mesh ``data`` axis directly.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataSet:
+    """A batch of ``features`` and (optionally one-hot) ``labels``."""
+
+    def __init__(self, features, labels=None):
+        self.features = features
+        self.labels = labels
+
+    # -- DL4J surface -------------------------------------------------------
+    def get_features(self):
+        return self.features
+
+    def get_labels(self):
+        return self.labels
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def __len__(self) -> int:
+        return self.num_examples()
+
+    def __repr__(self) -> str:
+        f = tuple(self.features.shape)
+        l = tuple(self.labels.shape) if self.labels is not None else None
+        return f"DataSet(features={f}, labels={l})"
+
+    # -- assembly (the reference builds 2-element List<DataSet>, :414-421) ---
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        """Row-concatenate several DataSets (Nd4j.vstack over a List<DataSet>)."""
+        feats = jnp.concatenate([d.features for d in datasets], axis=0)
+        if datasets[0].labels is None:
+            return DataSet(feats)
+        labels = jnp.concatenate([d.labels for d in datasets], axis=0)
+        return DataSet(feats, labels)
+
+    def to_device(self, sharding=None) -> "DataSet":
+        """Place the batch in device HBM (optionally sharded over a mesh)."""
+        put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jax.device_put
+        labels = put(self.labels) if self.labels is not None else None
+        return DataSet(put(self.features), labels)
+
+    def shard_batch(self, n: int) -> "DataSet":
+        """Check/truncate the batch to a multiple of ``n`` (mesh data-axis size)."""
+        b = self.num_examples()
+        usable = (b // n) * n
+        if usable == 0:
+            raise ValueError(f"batch of {b} cannot be split over {n} shards")
+        if usable == b:
+            return self
+        return DataSet(self.features[:usable], None if self.labels is None else self.labels[:usable])
+
+
+def one_hot(labels, num_classes: int, dtype=jnp.float32):
+    """Integer labels → one-hot rows (RecordReaderDataSetIterator's labelization)."""
+    labels = jnp.asarray(labels).astype(jnp.int32).reshape(-1)
+    return jax.nn.one_hot(labels, num_classes, dtype=dtype)
+
+
+def one_hot_np(labels: np.ndarray, num_classes: int, dtype=np.float32) -> np.ndarray:
+    """Host-side one-hot (used by iterators before device transfer)."""
+    labels = np.asarray(labels).astype(np.int64).reshape(-1)
+    out = np.zeros((labels.shape[0], num_classes), dtype=dtype)
+    out[np.arange(labels.shape[0]), labels] = 1.0
+    return out
+
+
+def _flatten_dataset(d: DataSet):
+    if d.labels is None:
+        return (d.features,), (False,)
+    return (d.features, d.labels), (True,)
+
+
+def _unflatten_dataset(aux, children):
+    (has_labels,) = aux
+    if has_labels:
+        return DataSet(children[0], children[1])
+    return DataSet(children[0])
+
+
+jax.tree_util.register_pytree_node(DataSet, _flatten_dataset, _unflatten_dataset)
+
+
+def train_test_split(features, labels, test_fraction: float, seed: int = 666):
+    """Deterministic host-side split helper (notebook-cell-2 style)."""
+    n = features.shape[0]
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_test = int(round(n * test_fraction))
+    test_idx, train_idx = perm[:n_test], perm[n_test:]
+    return (
+        (features[train_idx], labels[train_idx]),
+        (features[test_idx], labels[test_idx]),
+    )

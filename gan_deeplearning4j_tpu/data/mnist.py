@@ -1,0 +1,140 @@
+"""MNIST data prep — the gan.ipynb cell-2 analog (SURVEY §2.1 I19).
+
+The reference's notebook downloads MNIST via Keras, scales to [0,1] float32,
+flattens to 784, and writes ``mnist_train.csv`` / ``mnist_test.csv`` as
+``%.2f`` CSV with the integer label appended as column 785, plus a stratified
+100-per-class ``sampled_mnist_train.csv``. This module reproduces that file
+contract and adds a deterministic synthetic MNIST-like source for offline
+environments (this image has no network egress and no MNIST on disk), so
+tests and benches run anywhere; real CSVs in the reference's format are
+consumed transparently by the same loaders.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+IMAGE_SIDE = 28
+NUM_FEATURES = IMAGE_SIDE * IMAGE_SIDE  # 784 (dl4jGANComputerVision.java:71)
+NUM_CLASSES = 10
+
+
+def _class_templates(seed: int) -> np.ndarray:
+    """Ten smooth, well-separated 28×28 glyph templates. Each class is a
+    low-frequency random field (sum of seeded 2-D cosines) — smooth like pen
+    strokes, distinct across classes, so convnets have real signal to learn."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:IMAGE_SIDE, 0:IMAGE_SIDE].astype(np.float32) / IMAGE_SIDE
+    templates = np.zeros((NUM_CLASSES, IMAGE_SIDE, IMAGE_SIDE), dtype=np.float32)
+    for c in range(NUM_CLASSES):
+        field = np.zeros((IMAGE_SIDE, IMAGE_SIDE), dtype=np.float32)
+        for _ in range(6):
+            fx, fy = rng.uniform(0.5, 3.0, size=2)
+            px, py = rng.uniform(0, 2 * np.pi, size=2)
+            amp = rng.uniform(0.4, 1.0)
+            field += amp * np.cos(2 * np.pi * fx * xx + px) * np.cos(
+                2 * np.pi * fy * yy + py
+            )
+        field = (field - field.min()) / (field.max() - field.min() + 1e-8)
+        # soft vignette keeps mass centered like handwritten digits
+        r2 = (xx - 0.5) ** 2 + (yy - 0.5) ** 2
+        templates[c] = field * np.exp(-4.0 * r2)
+    return templates
+
+
+def synthetic_mnist(
+    num_train: int = 2000,
+    num_test: int = 500,
+    seed: int = 666,
+    noise: float = 0.08,
+    max_shift: int = 2,
+) -> Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic MNIST-shaped dataset: ((x_train, y_train), (x_test, y_test))
+    with x float32 in [0,1] of shape (N, 784) and y int labels — the exact
+    contract of ``mnist.load_data()`` post-processing in gan.ipynb cell 2."""
+    templates = _class_templates(seed)
+    rng = np.random.default_rng(seed + 1)
+
+    def make(n: int) -> Tuple[np.ndarray, np.ndarray]:
+        labels = rng.integers(0, NUM_CLASSES, size=n)
+        imgs = templates[labels].copy()
+        shifts = rng.integers(-max_shift, max_shift + 1, size=(n, 2))
+        for i in range(n):
+            imgs[i] = np.roll(imgs[i], shifts[i], axis=(0, 1))
+        imgs += rng.normal(0.0, noise, size=imgs.shape).astype(np.float32)
+        imgs = np.clip(imgs, 0.0, 1.0)
+        return imgs.reshape(n, NUM_FEATURES).astype(np.float32), labels.astype(np.int64)
+
+    return make(num_train), make(num_test)
+
+
+def write_mnist_csv(
+    path: str, features: np.ndarray, labels: np.ndarray, fmt: str = "%.2f"
+) -> str:
+    """Write the reference CSV layout: 784 feature columns then the label as
+    column 785, ``%.2f`` formatted (gan.ipynb cell 2's np.savetxt calls)."""
+    features = np.asarray(features, dtype=np.float32).reshape(len(labels), -1)
+    table = np.concatenate(
+        [features, np.asarray(labels, dtype=np.float32).reshape(-1, 1)], axis=1
+    )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savetxt(path, table, delimiter=",", fmt=fmt)
+    return path
+
+
+def stratified_sample(
+    features: np.ndarray, labels: np.ndarray, per_class: int = 100, seed: int = 666
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The notebook's 100-per-class ``sampled_mnist_train.csv`` subset."""
+    rng = np.random.default_rng(seed)
+    keep = []
+    for c in np.unique(labels):
+        idx = np.flatnonzero(labels == c)
+        take = min(per_class, idx.size)
+        keep.append(rng.choice(idx, size=take, replace=False))
+    keep = np.concatenate(keep)
+    rng.shuffle(keep)
+    return features[keep], labels[keep]
+
+
+def load_mnist_csv(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Read a reference-format CSV back into (features[N,784] float32 in [0,1],
+    labels[N] int64)."""
+    from gan_deeplearning4j_tpu.data.records import CSVRecordReader, FileSplit
+
+    reader = CSVRecordReader(0, ",")
+    reader.initialize(FileSplit(path))
+    data = reader.data
+    return data[:, :NUM_FEATURES].astype(np.float32), data[:, NUM_FEATURES].astype(np.int64)
+
+
+def prepare_mnist(
+    out_dir: str,
+    num_train: int = 2000,
+    num_test: int = 500,
+    seed: int = 666,
+    source: Optional[str] = None,
+) -> Tuple[str, str]:
+    """End-to-end cell-2 analog: obtain MNIST (real CSVs under ``source`` if
+    present, else synthetic), write ``mnist_train.csv`` + ``mnist_test.csv``
+    (+ the stratified sample) under ``out_dir``; returns the two paths."""
+    train_path = os.path.join(out_dir, "mnist_train.csv")
+    test_path = os.path.join(out_dir, "mnist_test.csv")
+    if source is not None:
+        src_train = os.path.join(source, "mnist_train.csv")
+        src_test = os.path.join(source, "mnist_test.csv")
+        if os.path.exists(src_train) and os.path.exists(src_test):
+            xtr, ytr = load_mnist_csv(src_train)
+            xte, yte = load_mnist_csv(src_test)
+        else:
+            raise FileNotFoundError(f"no mnist CSVs under {source!r}")
+    else:
+        (xtr, ytr), (xte, yte) = synthetic_mnist(num_train, num_test, seed)
+    write_mnist_csv(train_path, xtr, ytr)
+    write_mnist_csv(test_path, xte, yte)
+    xs, ys = stratified_sample(xtr, ytr, per_class=100, seed=seed)
+    write_mnist_csv(os.path.join(out_dir, "sampled_mnist_train.csv"), xs, ys)
+    return train_path, test_path

@@ -1,0 +1,163 @@
+"""Record readers — the DataVec surface the reference exercises (D13).
+
+Reference binding: ``CSVRecordReader(0, ",")`` over a
+``FileSplit(ClassPathResource("mnist_train.csv").getFile())``
+(dl4jGANComputerVision.java:372-377,395-400). Here a record reader yields
+numpy float32 rows; the iterator layer batches and labelizes them.
+
+The CSV path prefers the native C++ parser (``gan_deeplearning4j_tpu.native``)
+when its shared library has been built — the TPU-native stand-in for DataVec's
+JVM parsing — and falls back to numpy otherwise. Either way parsing happens
+once per file; batching reuses the materialized matrix.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class ClassPathResource:
+    """Resolve a data file by name against a search path (DL4J's
+    ``ClassPathResource`` resolved resources from the JVM classpath; here the
+    search path is ``GAN_DL4J_TPU_DATA`` + explicit roots + CWD)."""
+
+    def __init__(self, name: str, roots: Optional[Sequence[str]] = None):
+        self.name = name
+        env_root = os.environ.get("GAN_DL4J_TPU_DATA")
+        self.roots: List[str] = list(roots or [])
+        if env_root:
+            self.roots.append(env_root)
+        self.roots.extend([os.getcwd(), os.path.join(os.getcwd(), "resources")])
+
+    def get_file(self) -> str:
+        if os.path.isabs(self.name) and os.path.exists(self.name):
+            return self.name
+        for root in self.roots:
+            candidate = os.path.join(root, self.name)
+            if os.path.exists(candidate):
+                return candidate
+        raise FileNotFoundError(
+            f"resource {self.name!r} not found under {self.roots}"
+        )
+
+
+class FileSplit:
+    """Trivial split over one file/path (DL4J ``FileSplit``)."""
+
+    def __init__(self, path):
+        self.path = path if isinstance(path, str) else path.get_file()
+
+
+class RecordReader:
+    """Iteration protocol shared by all readers: ``has_next`` / ``next_record``
+    / ``reset`` (DL4J RecordReader)."""
+
+    def initialize(self, split: FileSplit) -> None:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_record(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        self.reset()
+        while self.has_next():
+            yield self.next_record()
+
+
+def _parse_csv(path: str, skip_lines: int, delimiter: str) -> np.ndarray:
+    """Parse a numeric CSV to float32, preferring the native C++ parser."""
+    try:
+        from gan_deeplearning4j_tpu.native import csv_loader
+
+        if csv_loader.available():
+            return csv_loader.load_csv(path, skip_lines=skip_lines, delimiter=delimiter)
+    except ImportError:
+        pass
+    return np.loadtxt(
+        path, delimiter=delimiter, skiprows=skip_lines, dtype=np.float32, ndmin=2
+    )
+
+
+class CSVRecordReader(RecordReader):
+    """``CSVRecordReader(skipLines, delimiter)`` analog. The whole file is
+    parsed to one float32 matrix up front (the reference re-reads per record
+    through the JVM; one parse + slicing is the device-friendly shape)."""
+
+    def __init__(self, skip_lines: int = 0, delimiter: str = ","):
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self._data: Optional[np.ndarray] = None
+        self._cursor = 0
+
+    def initialize(self, split: FileSplit) -> None:
+        self._data = _parse_csv(split.path, self.skip_lines, self.delimiter)
+        self._cursor = 0
+
+    @property
+    def data(self) -> np.ndarray:
+        if self._data is None:
+            raise RuntimeError("CSVRecordReader not initialized (call initialize)")
+        return self._data
+
+    def has_next(self) -> bool:
+        return self._cursor < self.data.shape[0]
+
+    def next_record(self) -> np.ndarray:
+        row = self.data[self._cursor]
+        self._cursor += 1
+        return row
+
+    def next_block(self, n: int) -> np.ndarray:
+        """Batched read — n rows at once (the device-friendly access path)."""
+        block = self.data[self._cursor : self._cursor + n]
+        self._cursor += block.shape[0]
+        return block
+
+    def remaining(self) -> int:
+        return self.data.shape[0] - self._cursor
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+
+class InMemoryRecordReader(RecordReader):
+    """Reader over an in-memory matrix (tests / synthetic data)."""
+
+    def __init__(self, data: np.ndarray):
+        self._data = np.asarray(data, dtype=np.float32)
+        self._cursor = 0
+
+    def initialize(self, split: Optional[FileSplit] = None) -> None:
+        self._cursor = 0
+
+    @property
+    def data(self) -> np.ndarray:
+        return self._data
+
+    def has_next(self) -> bool:
+        return self._cursor < self._data.shape[0]
+
+    def next_record(self) -> np.ndarray:
+        row = self._data[self._cursor]
+        self._cursor += 1
+        return row
+
+    def next_block(self, n: int) -> np.ndarray:
+        block = self._data[self._cursor : self._cursor + n]
+        self._cursor += block.shape[0]
+        return block
+
+    def remaining(self) -> int:
+        return self._data.shape[0] - self._cursor
+
+    def reset(self) -> None:
+        self._cursor = 0

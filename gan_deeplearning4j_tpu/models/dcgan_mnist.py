@@ -71,40 +71,49 @@ def _graph_config(cfg: DcganConfig) -> GraphConfig:
     )
 
 
+def _add_discriminator_layers(
+    b: GraphBuilder, prefix: str, start: int, lr: float, cfg: DcganConfig, input_name: str
+) -> str:
+    """The 7-layer discriminator stack shared by ``dis`` (names
+    ``dis_*_layer_1..7``, dl4jGANComputerVision.java:132-163) and the frozen
+    tail of ``gan`` (``gan_dis_*_layer_9..15``, :276-308). One definition keeps
+    the two copies structurally identical, which the DIS_TO_GAN weight-sync
+    protocol depends on. Returns the output-layer name."""
+    up = RmsProp(lr, 1e-8, 1e-8)
+    names = [f"{prefix}_{kind}_layer_{start + i}" for i, kind in enumerate(
+        ["batch", "conv2d", "maxpool", "conv2d", "maxpool", "dense", "output"]
+    )]
+    b.add_layer(names[0], BatchNormalization(updater=up), input_name)
+    b.add_layer(
+        names[1],
+        ConvolutionLayer(kernel=5, stride=2, n_in=cfg.channels, n_out=64, updater=up),
+        names[0],
+    )
+    b.add_layer(names[2], SubsamplingLayer(pool="max", kernel=2, stride=1), names[1])
+    b.add_layer(
+        names[3],
+        ConvolutionLayer(kernel=5, stride=2, n_in=64, n_out=128, updater=up),
+        names[2],
+    )
+    b.add_layer(names[4], SubsamplingLayer(pool="max", kernel=2, stride=1), names[3])
+    b.add_layer(names[5], DenseLayer(n_out=1024, updater=up), names[4])
+    b.add_layer(
+        names[6],
+        OutputLayer(n_out=cfg.num_classes_dis, activation="sigmoid", loss="xent", updater=up),
+        names[5],
+    )
+    return names[6]
+
+
 def build_discriminator(cfg: DcganConfig = DcganConfig()) -> ComputationGraph:
     """Trainable discriminator ``dis`` (dl4jGANComputerVision.java:118-166)."""
-    up = RmsProp(cfg.dis_learning_rate, 1e-8, 1e-8)
     b = GraphBuilder(_graph_config(cfg))
     b.add_inputs("dis_input_layer_0")
     b.set_input_types(InputType.convolutional_flat(cfg.height, cfg.width, cfg.channels))
-    b.add_layer("dis_batch_layer_1", BatchNormalization(updater=up), "dis_input_layer_0")
-    b.add_layer(
-        "dis_conv2d_layer_2",
-        ConvolutionLayer(kernel=5, stride=2, n_in=cfg.channels, n_out=64, updater=up),
-        "dis_batch_layer_1",
+    out = _add_discriminator_layers(
+        b, "dis", 1, cfg.dis_learning_rate, cfg, "dis_input_layer_0"
     )
-    b.add_layer(
-        "dis_maxpool_layer_3",
-        SubsamplingLayer(pool="max", kernel=2, stride=1),
-        "dis_conv2d_layer_2",
-    )
-    b.add_layer(
-        "dis_conv2d_layer_4",
-        ConvolutionLayer(kernel=5, stride=2, n_in=64, n_out=128, updater=up),
-        "dis_maxpool_layer_3",
-    )
-    b.add_layer(
-        "dis_maxpool_layer_5",
-        SubsamplingLayer(pool="max", kernel=2, stride=1),
-        "dis_conv2d_layer_4",
-    )
-    b.add_layer("dis_dense_layer_6", DenseLayer(n_out=1024, updater=up), "dis_maxpool_layer_5")
-    b.add_layer(
-        "dis_output_layer_7",
-        OutputLayer(n_out=cfg.num_classes_dis, activation="sigmoid", loss="xent", updater=up),
-        "dis_dense_layer_6",
-    )
-    b.set_outputs("dis_output_layer_7")
+    b.set_outputs(out)
     return b.build()
 
 
@@ -158,41 +167,14 @@ def build_gan(cfg: DcganConfig = DcganConfig()) -> ComputationGraph:
     """Stacked GAN: trainable generator (LR 0.004) feeding a frozen
     discriminator copy (LR 0.0), one XENT loss at the end so generator
     gradients flow through the frozen D (dl4jGANComputerVision.java:227-314)."""
-    frozen = RmsProp(cfg.frozen_learning_rate, 1e-8, 1e-8)
     b = GraphBuilder(_graph_config(cfg))
     b.add_inputs("gan_input_layer_0")
     b.set_input_types(InputType.feed_forward(cfg.z_size))
     gen_out = _add_generator_layers(b, "gan", cfg.gen_learning_rate, cfg, "gan_input_layer_0")
-    b.add_layer("gan_dis_batch_layer_9", BatchNormalization(updater=frozen), gen_out)
-    b.add_layer(
-        "gan_dis_conv2d_layer_10",
-        ConvolutionLayer(kernel=5, stride=2, n_in=cfg.channels, n_out=64, updater=frozen),
-        "gan_dis_batch_layer_9",
+    out = _add_discriminator_layers(
+        b, "gan_dis", 9, cfg.frozen_learning_rate, cfg, gen_out
     )
-    b.add_layer(
-        "gan_dis_maxpool_layer_11",
-        SubsamplingLayer(pool="max", kernel=2, stride=1),
-        "gan_dis_conv2d_layer_10",
-    )
-    b.add_layer(
-        "gan_dis_conv2d_layer_12",
-        ConvolutionLayer(kernel=5, stride=2, n_in=64, n_out=128, updater=frozen),
-        "gan_dis_maxpool_layer_11",
-    )
-    b.add_layer(
-        "gan_dis_maxpool_layer_13",
-        SubsamplingLayer(pool="max", kernel=2, stride=1),
-        "gan_dis_conv2d_layer_12",
-    )
-    b.add_layer(
-        "gan_dis_dense_layer_14", DenseLayer(n_out=1024, updater=frozen), "gan_dis_maxpool_layer_13"
-    )
-    b.add_layer(
-        "gan_dis_output_layer_15",
-        OutputLayer(n_out=cfg.num_classes_dis, activation="sigmoid", loss="xent", updater=frozen),
-        "gan_dis_dense_layer_14",
-    )
-    b.set_outputs("gan_dis_output_layer_15")
+    b.set_outputs(out)
     return b.build()
 
 

@@ -482,14 +482,18 @@ class ComputationGraph:
         return out
 
     # -- reporting ----------------------------------------------------------
+    def param_shapes(self) -> Dict[str, Dict[str, jax.ShapeDtypeStruct]]:
+        """Abstract param tree (shapes/dtypes only) — no device allocation."""
+        return jax.eval_shape(self.init)
+
     def param_count(self, params: Optional[Dict] = None) -> int:
-        params = params if params is not None else self.init()
+        params = params if params is not None else self.param_shapes()
         return sum(int(p.size) for lp in params.values() for p in lp.values())
 
     def summary(self, params: Optional[Dict] = None) -> str:
         """DL4J ``graph.summary()`` analog (printed by the reference after
         every build, dl4jGANComputerVision.java:167,223,312,365)."""
-        params = params if params is not None else self.init()
+        params = params if params is not None else self.param_shapes()
         rows = [("Name (type)", "In", "Out", "# Params")]
         for name, t in zip(self.input_names, self.input_types):
             rows.append((f"{name} (Input)", "-", str(t), "0"))

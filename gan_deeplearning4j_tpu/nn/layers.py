@@ -38,12 +38,7 @@ from gan_deeplearning4j_tpu.runtime.dtype import get_default_dtype
 
 IntPair = Union[int, Tuple[int, int]]
 
-
-def _pair(v) -> Tuple[int, int]:
-    if isinstance(v, int):
-        return (v, v)
-    a, b = v
-    return (int(a), int(b))
+_pair = conv_ops._pair  # single int-or-tuple normalizer shared with the ops layer
 
 
 @dataclasses.dataclass(frozen=True)

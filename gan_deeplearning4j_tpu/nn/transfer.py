@@ -22,8 +22,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
-import jax
-
 from gan_deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder, GraphConfig
 from gan_deeplearning4j_tpu.nn.layers import Layer
 from gan_deeplearning4j_tpu.optim.updaters import UpdaterSpec
@@ -158,26 +156,27 @@ class TransferLearning:
 
         outputs = self._new_outputs
         if outputs is None:
-            # keep surviving outputs; if the old head was removed, the last
-            # added layer becomes the output (reference behavior: new head)
+            # DL4J addLayer does not change outputs: keep surviving ones, and
+            # only if the removed head left none does the last added layer
+            # become the output (the reference's new-head case, :353-363)
             outputs = [o for o in src.output_names if o not in self._removed]
-            if self._added:
-                outputs = outputs + [self._added[-1]["name"]]
+            if not outputs and self._added:
+                outputs = [self._added[-1]["name"]]
             if not outputs:
                 raise ValueError("no outputs survive surgery; call set_outputs")
         builder.set_outputs(*outputs)
         new_graph = builder.build()
 
         # params: carry over retained layers, init only the genuinely new ones
+        # (fresh values come from the canonical ComputationGraph.init scheme so
+        # transfer-built and freshly built graphs initialize identically)
+        fresh = new_graph.init()
         new_params = {}
-        for idx, v in enumerate(new_graph.vertices):
+        for v in new_graph.vertices:
             if v.layer is None or not v.layer.has_params():
                 continue
             if v.name in self._params and v.name in kept:
                 new_params[v.name] = dict(self._params[v.name])
             else:
-                key = jax.random.fold_in(
-                    jax.random.PRNGKey(new_graph.config.seed), idx
-                )
-                new_params[v.name] = v.layer.init(key, v.in_type)
+                new_params[v.name] = fresh[v.name]
         return new_graph, new_params

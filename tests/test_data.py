@@ -1,0 +1,142 @@
+"""Data layer tests (SURVEY §2.2 D13-D14): CSV round trip, iterator semantics
+(batching, one-hot labelization, reset — dl4jGANComputerVision.java:372-377,
+395-400,600-602), prefetch wrapper, synthetic MNIST contract."""
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.data import (
+    ArrayDataSetIterator,
+    ClassPathResource,
+    CSVRecordReader,
+    DataSet,
+    DevicePrefetchIterator,
+    FileSplit,
+    InMemoryRecordReader,
+    RecordReaderDataSetIterator,
+    load_mnist_csv,
+    synthetic_mnist,
+    write_mnist_csv,
+)
+from gan_deeplearning4j_tpu.data.mnist import prepare_mnist, stratified_sample
+
+
+def test_synthetic_mnist_contract():
+    (xtr, ytr), (xte, yte) = synthetic_mnist(num_train=300, num_test=60)
+    assert xtr.shape == (300, 784) and xte.shape == (60, 784)
+    assert xtr.dtype == np.float32
+    assert xtr.min() >= 0.0 and xtr.max() <= 1.0
+    assert set(np.unique(ytr)) <= set(range(10))
+    # deterministic across calls
+    (xtr2, ytr2), _ = synthetic_mnist(num_train=300, num_test=60)
+    np.testing.assert_array_equal(xtr, xtr2)
+    np.testing.assert_array_equal(ytr, ytr2)
+
+
+def test_synthetic_mnist_classes_are_separable():
+    # class templates must be distinct enough that nearest-template
+    # classification beats chance by a wide margin — real learnable signal
+    (xtr, ytr), _ = synthetic_mnist(num_train=500, num_test=10)
+    means = np.stack([xtr[ytr == c].mean(axis=0) for c in range(10)])
+    d = ((xtr[:, None, :] - means[None, :, :]) ** 2).sum(-1)
+    acc = (d.argmin(axis=1) == ytr).mean()
+    assert acc > 0.9
+
+
+def test_csv_round_trip(tmp_path):
+    (x, y), _ = synthetic_mnist(num_train=50, num_test=10)
+    path = write_mnist_csv(str(tmp_path / "mnist_train.csv"), x, y)
+    x2, y2 = load_mnist_csv(path)
+    assert x2.shape == (50, 784)
+    np.testing.assert_array_equal(y, y2)
+    # %.2f quantization: within half a cent
+    assert np.abs(x - x2).max() <= 0.005 + 1e-6
+
+
+def test_classpath_resource_and_filesplit(tmp_path, monkeypatch):
+    p = tmp_path / "res.csv"
+    np.savetxt(p, np.eye(3), delimiter=",", fmt="%.2f")
+    monkeypatch.setenv("GAN_DL4J_TPU_DATA", str(tmp_path))
+    resource = ClassPathResource("res.csv")
+    assert resource.get_file() == str(p)
+    reader = CSVRecordReader(0, ",")
+    reader.initialize(FileSplit(resource))
+    assert reader.data.shape == (3, 3)
+    with pytest.raises(FileNotFoundError):
+        ClassPathResource("missing.csv", roots=[str(tmp_path)]).get_file()
+
+
+def test_record_reader_dataset_iterator(tmp_path):
+    (x, y), _ = synthetic_mnist(num_train=25, num_test=5)
+    path = write_mnist_csv(str(tmp_path / "t.csv"), x, y)
+    reader = CSVRecordReader(0, ",")
+    reader.initialize(FileSplit(path))
+    it = RecordReaderDataSetIterator(reader, batch_size=10, label_index=784, num_classes=10)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [10, 10, 5]
+    b0 = batches[0]
+    assert b0.features.shape == (10, 784)
+    assert b0.labels.shape == (10, 10)
+    np.testing.assert_allclose(np.asarray(b0.labels).sum(axis=1), 1.0)
+    np.testing.assert_array_equal(np.asarray(b0.labels).argmax(axis=1), y[:10])
+    # reset restarts from the top (dl4jGANComputerVision.java:600-602)
+    assert not it.has_next()
+    it.reset()
+    again = it.next()
+    np.testing.assert_array_equal(np.asarray(again.features), np.asarray(b0.features))
+
+
+def test_in_memory_reader_unlabeled():
+    data = np.arange(12, dtype=np.float32).reshape(4, 3)
+    it = RecordReaderDataSetIterator(InMemoryRecordReader(data), batch_size=3)
+    b = it.next()
+    assert b.labels is None
+    assert b.features.shape == (3, 3)
+
+
+def test_array_iterator_shuffle_and_epochs():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.eye(10, dtype=np.float32)
+    it = ArrayDataSetIterator(x, y, batch_size=4, shuffle=True, seed=7)
+    epoch1 = np.concatenate([np.asarray(b.features) for b in it])
+    epoch2 = np.concatenate([np.asarray(b.features) for b in it])
+    # same multiset of rows, different order per epoch
+    assert sorted(epoch1.ravel().tolist()) == sorted(x.ravel().tolist())
+    assert not np.array_equal(epoch1, epoch2)
+
+
+def test_dataset_merge_and_pytree():
+    import jax
+
+    a = DataSet(np.ones((2, 3), np.float32), np.zeros((2, 1), np.float32))
+    b = DataSet(np.zeros((3, 3), np.float32), np.ones((3, 1), np.float32))
+    m = DataSet.merge([a, b])
+    assert m.num_examples() == 5
+    leaves = jax.tree_util.tree_leaves(m)
+    assert len(leaves) == 2
+    doubled = jax.tree_util.tree_map(lambda v: v * 2, m)
+    assert isinstance(doubled, DataSet)
+
+
+def test_device_prefetch_matches_inner():
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    inner = ArrayDataSetIterator(x, batch_size=5)
+    pre = DevicePrefetchIterator(ArrayDataSetIterator(x, batch_size=5), depth=3)
+    got = [np.asarray(b.features) for b in pre]
+    want = [np.asarray(b.features) for b in inner]
+    assert len(got) == len(want) == 3
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    pre.reset()
+    assert pre.has_next()
+
+
+def test_stratified_sample_and_prepare(tmp_path):
+    (x, y), _ = synthetic_mnist(num_train=400, num_test=50)
+    xs, ys = stratified_sample(x, y, per_class=5)
+    counts = np.bincount(ys, minlength=10)
+    assert (counts <= 5).all() and counts.sum() == len(ys)
+    train_p, test_p = prepare_mnist(str(tmp_path), num_train=60, num_test=20)
+    xt, yt = load_mnist_csv(train_p)
+    assert xt.shape == (60, 784) and yt.shape == (60,)
+    assert (tmp_path / "sampled_mnist_train.csv").exists()
