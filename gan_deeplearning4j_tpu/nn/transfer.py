@@ -170,7 +170,7 @@ class TransferLearning:
         # params: carry over retained layers, init only the genuinely new ones
         # (fresh values come from the canonical ComputationGraph.init scheme so
         # transfer-built and freshly built graphs initialize identically)
-        fresh = new_graph.init()
+        fresh = None
         new_params = {}
         for v in new_graph.vertices:
             if v.layer is None or not v.layer.has_params():
@@ -178,5 +178,7 @@ class TransferLearning:
             if v.name in self._params and v.name in kept:
                 new_params[v.name] = dict(self._params[v.name])
             else:
+                if fresh is None:
+                    fresh = new_graph.init()
                 new_params[v.name] = fresh[v.name]
         return new_graph, new_params

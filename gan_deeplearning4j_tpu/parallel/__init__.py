@@ -1,0 +1,25 @@
+"""Distributed training — the TPU-native replacement for the reference's
+Spark layer (SURVEY §2.2 D15-D16, §2.3, §2.4).
+
+The reference scales with a Spark driver shipping serialized DataSets to
+JVM workers and averaging parameters through the driver
+(`SparkComputationGraph` + `ParameterAveragingTrainingMaster`,
+dl4jGANComputerVision.java:317-333). Here the "cluster" is a
+``jax.sharding.Mesh`` of TPU chips on ICI and the communication backend is
+XLA collectives:
+
+- :class:`GraphTrainer` — jitted train step; given a mesh, the batch is
+  sharded over the ``data`` axis and params are replicated, so XLA inserts
+  the gradient/batch-stat all-reduces over ICI automatically (per-step
+  gradient synchronization — the averaging_frequency→1 limit).
+- :class:`ParameterAveragingTrainer` — explicit ``shard_map`` reproduction of
+  the reference's sync parameter averaging: each mesh shard fits
+  ``averaging_frequency`` minibatches locally (divergent local params),
+  then params *and updater state* are arithmetically averaged with
+  ``lax.pmean`` (the map-reduce of gan.ipynb cell 3).
+"""
+
+from gan_deeplearning4j_tpu.parallel.trainer import GraphTrainer, TrainState
+from gan_deeplearning4j_tpu.parallel.param_averaging import ParameterAveragingTrainer
+
+__all__ = ["GraphTrainer", "TrainState", "ParameterAveragingTrainer"]

@@ -1,0 +1,167 @@
+"""GraphTrainer — the jitted fit loop (SURVEY §2.2 C/D15, §7.6).
+
+Replaces ``SparkComputationGraph.fit(JavaRDD<DataSet>)``
+(dl4jGANComputerVision.java:426,471,545) with a single compiled XLA program
+per step: forward → loss (+L2) → backward → per-layer updater. On a mesh,
+the batch is sharded over the ``data`` axis while params/optimizer state are
+replicated; XLA then inserts ``all-reduce`` over ICI for every cross-batch
+reduction — the gradient mean *and* BatchNorm's batch statistics (sync-BN),
+with no hand-written collectives. Buffers are donated so params update
+in-place in HBM (the workspace/buffer-donation analog of D19).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gan_deeplearning4j_tpu.optim.optimizer import GraphOptimizer
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TrainState:
+    """Params + per-layer updater state + step counter, as one pytree.
+
+    This is the unit the reference serializes per iteration
+    (``ModelSerializer.writeModel(…, saveUpdater=true)``,
+    dl4jGANComputerVision.java:605-619) and what the parameter-averaging
+    master broadcasts/averages.
+    """
+
+    params: Dict
+    opt_state: Dict
+    step: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+
+class GraphTrainer:
+    """Single-chip or data-parallel trainer for one ComputationGraph.
+
+    With ``mesh=None`` the step jits for whatever device jax defaults to
+    (one TPU chip). With a mesh, ``data_axis`` names the batch-sharded axis;
+    parameters stay replicated, which is the right layout at this model
+    scale (all-reduce of grads rides ICI; no parameter sharding needed —
+    SURVEY §2.3 leaves the ``model`` axis open but unused, as the reference
+    has no tensor parallelism).
+    """
+
+    def __init__(
+        self,
+        graph,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        data_axis: str = "data",
+        donate: bool = True,
+    ):
+        self.graph = graph
+        self.optimizer = GraphOptimizer(graph)
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self._step_fn = self._build_step(donate)
+        self._eval_fn = None
+
+    # -- state --------------------------------------------------------------
+    def init_state(self, seed: Optional[int] = None, params: Optional[Dict] = None) -> TrainState:
+        if params is None:
+            params = self.graph.init(seed)
+        state = TrainState(
+            params=params,
+            opt_state=self.optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        if self.mesh is not None:
+            state = jax.device_put(state, self._replicated())
+        return state
+
+    def _replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self) -> Optional[NamedSharding]:
+        """Sharding for incoming batches (leading/batch dim over the data
+        axis) — hand this to DevicePrefetchIterator so batches land
+        pre-sharded."""
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    # -- the step ------------------------------------------------------------
+    def _loss_fn(self, params, features, labels, rng):
+        loss, (_, new_params) = self.graph.loss(
+            params, features, labels, train=True, rng=rng
+        )
+        return loss, new_params
+
+    def _build_step(self, donate: bool):
+        def step(state: TrainState, features, labels, rng) -> Tuple[TrainState, jnp.ndarray]:
+            (loss, new_params), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(state.params, features, labels, rng)
+            # new_params carries BN running-stat updates from the forward
+            # pass; the optimizer never touches "state"-role params.
+            params, opt_state = self.optimizer.step(new_params, grads, state.opt_state)
+            return TrainState(params, opt_state, state.step + 1), loss
+
+        kwargs: Dict[str, Any] = {}
+        if donate:
+            kwargs["donate_argnums"] = (0,)
+        if self.mesh is not None:
+            rep = self._replicated()
+            data = NamedSharding(self.mesh, P(self.data_axis))
+            kwargs["in_shardings"] = (rep, data, data, rep)
+            kwargs["out_shardings"] = (rep, rep)
+        return jax.jit(step, **kwargs)
+
+    def train_step(self, state: TrainState, features, labels, rng=None) -> Tuple[TrainState, jnp.ndarray]:
+        """One optimizer step. ``rng`` feeds dropout-style layers (unused by
+        the reference topologies; pass None for a fixed key)."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return self._step_fn(state, features, labels, rng)
+
+    # -- fit ----------------------------------------------------------------
+    def fit(
+        self,
+        state: TrainState,
+        iterator,
+        num_batches: Optional[int] = None,
+        rng=None,
+    ) -> Tuple[TrainState, List[float]]:
+        """Consume a DataSetIterator (DL4J ``fit(iterator)``). Returns the
+        new state and per-batch losses (host floats, fetched at the end)."""
+        losses = []
+        seen = 0
+        if rng is None:
+            rng = jax.random.PRNGKey(int(state.step))
+        while iterator.has_next() and (num_batches is None or seen < num_batches):
+            batch = iterator.next()
+            rng, sub = jax.random.split(rng)
+            state, loss = self.train_step(state, batch.features, batch.labels, sub)
+            losses.append(loss)
+            seen += 1
+        return state, [float(l) for l in losses]
+
+    # -- inference ----------------------------------------------------------
+    def output(self, state: TrainState, features):
+        """Jitted inference forward (DL4J ``graph.output``)."""
+        if self._eval_fn is None:
+            kwargs = {}
+            if self.mesh is not None:
+                kwargs["in_shardings"] = (
+                    self._replicated(),
+                    NamedSharding(self.mesh, P(self.data_axis)),
+                )
+            self._eval_fn = jax.jit(
+                lambda params, x: self.graph.output(params, x, train=False), **kwargs
+            )
+        return self._eval_fn(state.params, features)

@@ -1,0 +1,216 @@
+"""Distributed-trainer tests on the fake 8-device CPU mesh (SURVEY §4 item 4
+— the analog of the reference's Spark ``local[4]`` trick).
+
+Covers: single-chip convergence, mesh-vs-single-chip numerical equivalence
+(per-step gradient sync), and parameter-averaging semantics — the shard_map
+round must equal W independent local fits followed by an arithmetic mean
+(the map-reduce of gan.ipynb cell 3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.data import ArrayDataSetIterator
+from gan_deeplearning4j_tpu.nn import (
+    BatchNormalization,
+    DenseLayer,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.optim import GraphOptimizer, RmsProp
+from gan_deeplearning4j_tpu.parallel import (
+    GraphTrainer,
+    ParameterAveragingTrainer,
+    TrainState,
+)
+from gan_deeplearning4j_tpu.runtime import TpuEnvironment
+
+
+def small_classifier(n_in=8, n_hidden=16, n_classes=3, lr=0.01):
+    b = GraphBuilder(
+        GraphConfig(
+            seed=666,
+            l2=1e-4,
+            gradient_clip="elementwise",
+            gradient_clip_value=1.0,
+            updater=RmsProp(lr, 0.95, 1e-8),
+        )
+    )
+    b.add_inputs("in")
+    b.set_input_types(InputType.feed_forward(n_in))
+    b.add_layer("dense", DenseLayer(n_out=n_hidden), "in")
+    b.add_layer("bn", BatchNormalization(), "dense")
+    b.add_layer("out", OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"), "bn")
+    b.set_outputs("out")
+    return b.build()
+
+
+def toy_data(n=256, n_in=8, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = (np.abs(x).sum(axis=1) * 1.7).astype(np.int64) % n_classes
+    onehot = np.zeros((n, n_classes), np.float32)
+    onehot[np.arange(n), y] = 1.0
+    return x, onehot
+
+
+class TestGraphTrainer:
+    def test_loss_decreases_single_chip(self):
+        graph = small_classifier()
+        trainer = GraphTrainer(graph)
+        state = trainer.init_state()
+        x, y = toy_data()
+        it = ArrayDataSetIterator(x, y, batch_size=32)
+        state, losses = trainer.fit(state, it)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        assert int(state.step) == len(losses)
+
+    def test_bn_stats_update_through_step(self):
+        graph = small_classifier()
+        trainer = GraphTrainer(graph)
+        state = trainer.init_state()
+        x, y = toy_data(64)
+        before = np.asarray(state.params["bn"]["mean"])
+        state, _ = trainer.train_step(state, jnp.asarray(x), jnp.asarray(y))
+        after = np.asarray(state.params["bn"]["mean"])
+        assert not np.allclose(before, after)
+
+    def test_mesh_step_matches_single_chip(self):
+        """Per-step gradient sync on the mesh is the same global-batch math as
+        one chip: params replicated, batch sharded, XLA inserts the
+        all-reduce. Results must agree to float tolerance."""
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        solo = GraphTrainer(graph, donate=False)
+        dist = GraphTrainer(graph, mesh=mesh, donate=False)
+        x, y = toy_data(128)
+        xs, ys = jnp.asarray(x), jnp.asarray(y)
+        s_solo = solo.init_state()
+        s_dist = dist.init_state()
+        for _ in range(3):
+            s_solo, l_solo = solo.train_step(s_solo, xs, ys)
+            s_dist, l_dist = dist.train_step(s_dist, xs, ys)
+        np.testing.assert_allclose(float(l_solo), float(l_dist), rtol=1e-4)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            s_solo.params,
+            s_dist.params,
+        )
+
+    def test_output_on_mesh(self):
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        trainer = GraphTrainer(graph, mesh=mesh)
+        state = trainer.init_state()
+        x, _ = toy_data(64)
+        out = trainer.output(state, jnp.asarray(x))
+        assert out.shape == (64, 3)
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-5)
+
+
+class TestParameterAveraging:
+    def test_round_equals_manual_worker_average(self):
+        """One shard_map round == W independent local fits + arithmetic mean
+        of params and updater state (ParameterAveragingTrainingMaster
+        semantics, dl4jGANComputerVision.java:325-330)."""
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        W, freq, b = 8, 2, 4
+        pa = ParameterAveragingTrainer(
+            graph, mesh, batch_size_per_worker=b, averaging_frequency=freq
+        )
+        assert pa.num_workers == W
+        x, y = toy_data(W * freq * b)
+        state0 = pa.init_state()
+        state1, losses = pa.fit_round(state0, jnp.asarray(x), jnp.asarray(y))
+        assert losses.shape == (freq,)
+        assert np.isfinite(np.asarray(losses)).all()
+        assert int(state1.step) == freq
+
+        # manual reproduction with the single-chip machinery
+        opt = GraphOptimizer(graph)
+        params0 = graph.init()
+        opt0 = opt.init(params0)
+        worker_params, worker_opt = [], []
+        for w in range(W):
+            p, s = params0, opt0
+            for k in range(freq):
+                lo = w * freq * b + k * b
+                mb_x, mb_y = jnp.asarray(x[lo : lo + b]), jnp.asarray(y[lo : lo + b])
+
+                def loss_fn(pp):
+                    loss, (_, new_p) = graph.loss(pp, mb_x, mb_y, train=True)
+                    return loss, new_p
+
+                (_, new_p), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+                p, s = opt.step(new_p, grads, s)
+            worker_params.append(p)
+            worker_opt.append(s)
+        mean_params = jax.tree_util.tree_map(
+            lambda *xs: sum(xs) / len(xs), *worker_params
+        )
+        mean_opt = jax.tree_util.tree_map(lambda *xs: sum(xs) / len(xs), *worker_opt)
+        jax.tree_util.tree_map(
+            lambda a, e: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5
+            ),
+            state1.params,
+            mean_params,
+        )
+        jax.tree_util.tree_map(
+            lambda a, e: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5
+            ),
+            state1.opt_state,
+            mean_opt,
+        )
+
+    def test_averaging_differs_from_per_step_sync(self):
+        """freq>1 local divergence is a different algorithm from per-step
+        gradient averaging (SURVEY §7 hard parts) — assert they disagree."""
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        W, freq, b = 8, 4, 4
+        pa = ParameterAveragingTrainer(graph, mesh, batch_size_per_worker=b, averaging_frequency=freq)
+        x, y = toy_data(W * freq * b)
+        s_pa, _ = pa.fit_round(pa.init_state(), jnp.asarray(x), jnp.asarray(y))
+        sync = GraphTrainer(graph, mesh=mesh, donate=False)
+        s_sync = sync.init_state()
+        # same data as freq global steps of W*b rows (worker-major regroup)
+        xr = np.asarray(x).reshape(W, freq, b, -1).swapaxes(0, 1).reshape(freq, W * b, -1)
+        yr = np.asarray(y).reshape(W, freq, b, -1).swapaxes(0, 1).reshape(freq, W * b, -1)
+        for k in range(freq):
+            s_sync, _ = sync.train_step(s_sync, jnp.asarray(xr[k]), jnp.asarray(yr[k]))
+        diffs = jax.tree_util.tree_map(
+            lambda a, b_: float(np.max(np.abs(np.asarray(a) - np.asarray(b_)))),
+            s_pa.params,
+            s_sync.params,
+        )
+        assert max(jax.tree_util.tree_leaves(diffs)) > 1e-6
+
+    def test_iterator_front_end_honors_frequency(self):
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        pa = ParameterAveragingTrainer(graph, mesh, batch_size_per_worker=4, averaging_frequency=2)
+        # 168 rows: two full rounds of 8*2*4=64, then a tail round of freq 1
+        # (32 rows), then 8 rows dropped (< one minibatch per worker)
+        x, y = toy_data(8 * 2 * 4 * 2 + 40)
+        it = ArrayDataSetIterator(x, y, batch_size=32)
+        state, losses = pa.fit(pa.init_state(), it)
+        assert len(losses) == 2 + 2 + 1
+        assert int(state.step) == 5
+        assert np.isfinite(losses).all()
+
+    def test_bad_round_size_raises(self):
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        pa = ParameterAveragingTrainer(graph, mesh, batch_size_per_worker=4, averaging_frequency=2)
+        x, y = toy_data(17)
+        with pytest.raises(ValueError):
+            pa.fit_round(pa.init_state(), jnp.asarray(x), jnp.asarray(y))
