@@ -1,0 +1,60 @@
+"""CLI entry point — ``python -m gan_deeplearning4j_tpu [flags]``.
+
+The reference's ``main`` (dl4jGANComputerVision.java:94-101) echoes argv and
+runs the GAN experiment end to end; here the flags actually configure the run
+(see ``--help``). Data: reference-format MNIST CSVs under ``--data-dir`` are
+used if present, else the deterministic synthetic set is generated there.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+from gan_deeplearning4j_tpu.data import (
+    CSVRecordReader,
+    FileSplit,
+    RecordReaderDataSetIterator,
+)
+from gan_deeplearning4j_tpu.data.mnist import prepare_mnist
+from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+from gan_deeplearning4j_tpu.runtime import backend_info
+
+
+def _csv_iterator(path: str, batch: int, label_index: int, num_classes: int):
+    reader = CSVRecordReader(0, ",")
+    reader.initialize(FileSplit(path))
+    return RecordReaderDataSetIterator(reader, batch, label_index, num_classes)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    print("Program arguments:", sys.argv[1:] if argv is None else argv)
+    config = ExperimentConfig.from_args(argv)
+    print("Execution backend:", backend_info())
+
+    train_csv = os.path.join(config.data_dir, f"{config.file_prefix}_train.csv")
+    test_csv = os.path.join(config.data_dir, f"{config.file_prefix}_test.csv")
+    if not (os.path.exists(train_csv) and os.path.exists(test_csv)):
+        print(f"No CSVs under {config.data_dir!r}; generating synthetic MNIST there.")
+        prepare_mnist(config.data_dir)
+
+    train_it = _csv_iterator(
+        train_csv, config.batch_size_train, config.num_features, config.num_classes
+    )
+    test_it = _csv_iterator(
+        test_csv, config.batch_size_pred, config.num_features, config.num_classes
+    )
+
+    experiment = GanExperiment(config)
+    result = experiment.run(train_it, test_it)
+    print(f"Done: {result['iterations']} iterations")
+    print(experiment.timer.report())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Experiment harness — the application layer (SURVEY §1 layer A, §2.1 I1-I17).
+
+The reference's entire runtime is one class, ``dl4jGANComputerVision``:
+config constants, three graphs, the transfer classifier, the alternating
+train loop with named-parameter weight sync, CSV exports, and per-iteration
+checkpointing. This package is that application rebuilt on the TPU-native
+stack: :class:`ExperimentConfig` (the ~24-constant block, CLI/JSON
+overridable) and :class:`GanExperiment` (the loop).
+"""
+
+from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
+from gan_deeplearning4j_tpu.harness.experiment import GanExperiment
+
+__all__ = ["ExperimentConfig", "GanExperiment"]

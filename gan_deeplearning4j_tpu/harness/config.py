@@ -1,0 +1,120 @@
+"""ExperimentConfig — the reference's hyperparameter block as one typed,
+overridable config (SURVEY §5 config/flag system).
+
+The reference hardcodes everything as ``static final`` constants
+(dl4jGANComputerVision.java:66-92) and *ignores* its CLI args (:99-101).
+Field-for-field the defaults below equal the reference's values; unlike the
+reference they are overridable from JSON and argparse.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    # -- batching & shapes (dl4jGANComputerVision.java:66-81) ---------------
+    batch_size_train: int = 200
+    batch_size_pred: int = 500
+    num_features: int = 784
+    num_classes: int = 10
+    num_classes_dis: int = 1
+    num_iterations: int = 2  # the while-loop bound (:72,408)
+    latent_grid: int = 10  # 10×10 sample grid (:74-75)
+    height: int = 28
+    width: int = 28
+    channels: int = 1
+    z_size: int = 2
+
+    # -- learning rates & reg (:82-86) --------------------------------------
+    dis_learning_rate: float = 0.002
+    gen_learning_rate: float = 0.004
+    frozen_learning_rate: float = 0.0
+    l2: float = 1e-4
+    grad_clip: float = 1.0
+    seed: int = 666  # (:85)
+
+    # -- cadences & paths (:76-77,87-90) -------------------------------------
+    print_every: int = 1
+    save_every: int = 1
+    data_dir: str = "data"
+    output_dir: str = "output"
+    file_prefix: str = "mnist"
+    save_models: bool = True
+
+    # -- label softening (:404-406) ------------------------------------------
+    label_softening: float = 0.05
+    # The reference samples the ±0.05·randn noise ONCE and reuses it every
+    # batch — a quirk SURVEY §7 says to decide deliberately. Default preserves
+    # reference behavior; True resamples per batch (standard practice).
+    resample_label_noise: bool = False
+
+    # -- distributed (the Spark local[4] + TrainingMaster block, :317-330) ---
+    # "none": single chip; "pmean": per-step gradient sync over the mesh;
+    # "param_averaging": k-step synchronous parameter averaging (reference
+    # semantics, averagingFrequency=10 :326).
+    distributed: str = "none"
+    averaging_frequency: int = 10
+    batch_size_per_worker: int = 200
+    prefetch: int = 0  # workerPrefetchNumBatches (:328); >0 enables device prefetch
+    use_accelerator: bool = True  # the useGpu flag (:92)
+
+    # -- observability --------------------------------------------------------
+    metrics_jsonl: Optional[str] = None
+    profile_dir: Optional[str] = None
+
+    def validate(self) -> "ExperimentConfig":
+        if self.num_features != self.height * self.width * self.channels:
+            raise ValueError(
+                f"num_features {self.num_features} != h*w*c "
+                f"{self.height * self.width * self.channels}"
+            )
+        if self.distributed not in ("none", "pmean", "param_averaging"):
+            raise ValueError(f"unknown distributed mode {self.distributed!r}")
+        return self
+
+    # -- overrides ------------------------------------------------------------
+    @staticmethod
+    def from_json(path: str) -> "ExperimentConfig":
+        with open(path) as fh:
+            return ExperimentConfig(**json.load(fh)).validate()
+
+    def to_json(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(dataclasses.asdict(self), fh, indent=2)
+
+    @staticmethod
+    def parser() -> argparse.ArgumentParser:
+        """Argparse with one flag per field (the CLI the reference echoes but
+        ignores, made real)."""
+        p = argparse.ArgumentParser(
+            prog="gan_deeplearning4j_tpu",
+            description="DCGAN-MNIST experiment (TPU-native rebuild)",
+        )
+        p.add_argument("--config", type=str, default=None, help="JSON config file")
+        for f in dataclasses.fields(ExperimentConfig):
+            arg = "--" + f.name.replace("_", "-")
+            if f.type == "bool" or isinstance(f.default, bool):
+                p.add_argument(arg, type=lambda s: s.lower() in ("1", "true", "yes"),
+                               default=None, metavar="BOOL")
+            elif f.default is None or f.type.startswith("Optional"):
+                p.add_argument(arg, type=str, default=None)
+            else:
+                p.add_argument(arg, type=type(f.default), default=None)
+        return p
+
+    @staticmethod
+    def from_args(argv: Optional[Sequence[str]] = None) -> "ExperimentConfig":
+        args = vars(ExperimentConfig.parser().parse_args(argv))
+        config_path = args.pop("config", None)
+        base = (
+            ExperimentConfig.from_json(config_path)
+            if config_path
+            else ExperimentConfig()
+        )
+        overrides = {k: v for k, v in args.items() if v is not None}
+        return dataclasses.replace(base, **overrides).validate()

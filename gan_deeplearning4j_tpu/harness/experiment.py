@@ -1,0 +1,305 @@
+"""GanExperiment — the alternating training loop (SURVEY §2.1 I13-I17, §3.2).
+
+One iteration reproduces the reference's hot loop (dl4jGANComputerVision.java:
+408-621):
+
+1. real batch from the train iterator; fake batch from the frozen sampler
+   ``gen`` on z ~ U(−1,1);
+2. discriminator fit on [real + softened-1 labels, fake + softened-0 labels];
+3. named-param sync dis → gan frozen tail (12 copies → one bulk map);
+4. GAN fit on [z, labels=1] — the generator step through the frozen D;
+5. sync gan → gen (refresh the sampler), dis → classifier feature layers;
+6. classifier fit on the real labeled batch;
+7. exports: 10×10 z-grid manifold CSV + batched test-set predictions CSV;
+8. all four models checkpointed with updater state.
+
+TPU-native differences: the "param copies" are pytree rebinds (no data
+movement — the arrays stay in HBM and are shared by reference); exports do
+one batched device→host fetch instead of per-scalar ``getDouble`` reads
+(the §3.3 pathology); and the Spark layer is replaced by the mesh trainers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gan_deeplearning4j_tpu.data import ArrayDataSetIterator, DevicePrefetchIterator
+from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
+from gan_deeplearning4j_tpu.models import dcgan_mnist
+from gan_deeplearning4j_tpu.nn import ComputationGraph
+from gan_deeplearning4j_tpu.parallel import (
+    GraphTrainer,
+    ParameterAveragingTrainer,
+    TrainState,
+)
+from gan_deeplearning4j_tpu.runtime import TpuEnvironment
+from gan_deeplearning4j_tpu.utils import write_model
+from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
+from gan_deeplearning4j_tpu.utils.profiling import PhaseTimer, device_trace
+
+logger = logging.getLogger(__name__)
+
+
+def latent_grid(n: int, z_size: int = 2) -> np.ndarray:
+    """The n×n manifold grid over linspace(−1,1,n)² (reference :382-389).
+    For z_size > 2 the remaining dims are zero (grid spans the first two)."""
+    line = np.linspace(-1.0, 1.0, n, dtype=np.float32)
+    a, b = np.meshgrid(line, line, indexing="ij")
+    grid = np.zeros((n * n, z_size), dtype=np.float32)
+    grid[:, 0] = a.ravel()
+    grid[:, 1 % z_size] = b.ravel()
+    return grid
+
+
+class GanExperiment:
+    """The application loop, assembled from the framework layers."""
+
+    def __init__(self, config: ExperimentConfig = ExperimentConfig(), mesh=None):
+        self.config = config.validate()
+        cfg = config
+        self.model_cfg = dcgan_mnist.DcganConfig(
+            height=cfg.height,
+            width=cfg.width,
+            channels=cfg.channels,
+            num_features=cfg.num_features,
+            num_classes=cfg.num_classes,
+            num_classes_dis=cfg.num_classes_dis,
+            z_size=cfg.z_size,
+            dis_learning_rate=cfg.dis_learning_rate,
+            gen_learning_rate=cfg.gen_learning_rate,
+            frozen_learning_rate=cfg.frozen_learning_rate,
+            seed=cfg.seed,
+            l2=cfg.l2,
+            grad_clip=cfg.grad_clip,
+        )
+
+        if mesh is None and cfg.distributed != "none":
+            mesh = TpuEnvironment().make_mesh()
+        self.mesh = mesh
+
+        # the three graphs + transfer classifier (I4-I6, I11)
+        self.dis = dcgan_mnist.build_discriminator(self.model_cfg)
+        self.gen = dcgan_mnist.build_generator(self.model_cfg)
+        self.gan = dcgan_mnist.build_gan(self.model_cfg)
+        dis_params = self.dis.init()
+        self.cv, cv_params = dcgan_mnist.build_transfer_classifier(
+            self.dis, dis_params, self.model_cfg
+        )
+
+        self.dis_trainer = self._make_trainer(self.dis)
+        self.gan_trainer = self._make_trainer(self.gan)
+        self.cv_trainer = self._make_trainer(self.cv)
+        self.dis_state = self.dis_trainer.init_state(params=dis_params)
+        self.gan_state = self.gan_trainer.init_state()
+        self.cv_state = self.cv_trainer.init_state(params=cv_params)
+        self.gen_params = self.gen.init()
+        self._gen_fwd = jax.jit(lambda p, z: self.gen.output(p, z, train=False))
+
+        # label-softening noise, sampled ONCE like the reference (:404-406)
+        # unless resample_label_noise asks for per-batch redraws
+        rng = np.random.default_rng(cfg.seed)
+        self._noise_rng = rng
+        b = cfg.batch_size_train
+        self._eps_real = self._soft_noise(b)
+        self._eps_fake = self._soft_noise(b)
+        self._z_rng = np.random.default_rng(cfg.seed + 1)
+        self._z_grid = latent_grid(cfg.latent_grid, cfg.z_size)
+
+        self.timer = PhaseTimer()
+        self.metrics = MetricsLogger(cfg.metrics_jsonl)
+        self.batch_counter = 0
+
+    # ------------------------------------------------------------------
+    def _make_trainer(self, graph: ComputationGraph):
+        cfg = self.config
+        if cfg.distributed == "param_averaging":
+            return ParameterAveragingTrainer(
+                graph,
+                self.mesh,
+                batch_size_per_worker=cfg.batch_size_per_worker,
+                averaging_frequency=cfg.averaging_frequency,
+            )
+        mesh = self.mesh if cfg.distributed == "pmean" else None
+        return GraphTrainer(graph, mesh=mesh)
+
+    def _soft_noise(self, n: int) -> np.ndarray:
+        return (
+            self.config.label_softening
+            * self._noise_rng.standard_normal((n, 1)).astype(np.float32)
+        )
+
+    def _sample_z(self, n: int) -> np.ndarray:
+        """z ~ U(−1,1) via rand·2−1 (reference :420,465)."""
+        return (self._z_rng.random((n, self.config.z_size), dtype=np.float32) * 2.0 - 1.0)
+
+    @staticmethod
+    def _copied_layers(src_params: Dict, mapping: Dict[str, str]) -> Dict:
+        """Materialized device copies of the mapped layers. The copy is
+        required for correctness under buffer donation: the source trainer's
+        jitted step donates its state buffers, so the destination model must
+        own its bytes — exactly the semantics of the reference's setParam
+        copies (:429-542), still a device-to-device HBM copy, no host hop."""
+        return {
+            layer: {p: jnp.copy(v) for p, v in src_params[layer].items()}
+            for layer in mapping
+        }
+
+    def _sync(self, src_state, dst_state: TrainState, mapping: Dict[str, str]) -> TrainState:
+        """Named-param weight sync (the reference's setParam blocks :429-542)."""
+        src = src_state.params if isinstance(src_state, TrainState) else src_state
+        return TrainState(
+            ComputationGraph.copy_params(self._copied_layers(src, mapping), dst_state.params, mapping),
+            dst_state.opt_state,
+            dst_state.step,
+        )
+
+    # ------------------------------------------------------------------
+    def train_iteration(self, real_features, real_labels) -> Dict[str, float]:
+        """One full alternating iteration (§3.2). Inputs are the real batch:
+        features (B, num_features) in [0,1] and one-hot labels (B, classes)."""
+        cfg = self.config
+        b = int(real_features.shape[0])
+        eps_r, eps_f = self._eps_real[:b], self._eps_fake[:b]
+        if cfg.resample_label_noise:
+            eps_r, eps_f = self._soft_noise(b), self._soft_noise(b)
+
+        # (a) fake batch from the frozen sampler
+        with self.timer.phase("sample_fake"):
+            fake = self._gen_fwd(self.gen_params, jnp.asarray(self._sample_z(b)))
+            fake = fake.reshape(b, cfg.num_features)
+
+        # (b) discriminator step: [real→soft 1, fake→soft 0] as two
+        # minibatches, exactly the reference's 2-element List<DataSet> (:414-421)
+        with self.timer.phase("train_dis"):
+            dis_feats = jnp.concatenate([jnp.asarray(real_features), fake], axis=0)
+            dis_labels = jnp.concatenate(
+                [1.0 + jnp.asarray(eps_r), 0.0 + jnp.asarray(eps_f)], axis=0
+            )
+            it = ArrayDataSetIterator(
+                np.asarray(dis_feats), np.asarray(dis_labels), batch_size=b
+            )
+            self.dis_state, d_losses = self.dis_trainer.fit(self.dis_state, it)
+
+        # (c) dis → gan frozen tail (:429-460)
+        self.gan_state = self._sync(self.dis_state, self.gan_state, dcgan_mnist.DIS_TO_GAN)
+
+        # (d) generator step through the frozen D: [z, ones] (:462-471)
+        with self.timer.phase("train_gan"):
+            z = self._sample_z(b)
+            ones = np.ones((b, 1), np.float32)
+            it = ArrayDataSetIterator(z, ones, batch_size=b)
+            self.gan_state, g_losses = self.gan_trainer.fit(self.gan_state, it)
+
+        # (e) gan → gen refresh (:473-510); dis → classifier features (:512-542)
+        self.gen_params = ComputationGraph.copy_params(
+            self._copied_layers(self.gan_state.params, dcgan_mnist.GAN_TO_GEN),
+            self.gen_params,
+            dcgan_mnist.GAN_TO_GEN,
+        )
+        self.cv_state = self._sync(self.dis_state, self.cv_state, dcgan_mnist.DIS_TO_CV)
+
+        # (f) classifier step on the real labeled batch (:544-545)
+        with self.timer.phase("train_cv"):
+            it = ArrayDataSetIterator(
+                np.asarray(real_features), np.asarray(real_labels), batch_size=b
+            )
+            self.cv_state, cv_losses = self.cv_trainer.fit(self.cv_state, it)
+
+        return {
+            "d_loss": float(np.mean(d_losses)) if d_losses else float("nan"),
+            "g_loss": float(np.mean(g_losses)) if g_losses else float("nan"),
+            "cv_loss": float(np.mean(cv_losses)) if cv_losses else float("nan"),
+        }
+
+    # -- exports (I15) --------------------------------------------------
+    def export_manifold(self, index: int) -> str:
+        """Decode the z-grid and write ``{prefix}_out_{index}.csv`` —
+        (grid², num_features) rows, one batched host fetch (:550-570)."""
+        cfg = self.config
+        out = self._gen_fwd(self.gen_params, jnp.asarray(self._z_grid))
+        out = np.asarray(out).reshape(self._z_grid.shape[0], cfg.num_features)
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        path = os.path.join(cfg.output_dir, f"{cfg.file_prefix}_out_{index}.csv")
+        np.savetxt(path, out, delimiter=",", fmt="%.6f")
+        return path
+
+    def export_predictions(self, test_iterator, index: int) -> str:
+        """Batched test-set inference → ``{prefix}_test_predictions_{index}.csv``
+        (:572-598): reset, stream batches through the classifier, vstack."""
+        cfg = self.config
+        test_iterator.reset()
+        chunks: List[np.ndarray] = []
+        while test_iterator.has_next():
+            batch = test_iterator.next()
+            chunks.append(np.asarray(self.cv_trainer.output(self.cv_state, batch.features)))
+        preds = np.vstack(chunks) if chunks else np.zeros((0, cfg.num_classes))
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        path = os.path.join(
+            cfg.output_dir, f"{cfg.file_prefix}_test_predictions_{index}.csv"
+        )
+        np.savetxt(path, preds, delimiter=",", fmt="%.6f")
+        return path
+
+    def save_models(self) -> List[str]:
+        """All four models with updater state, every iteration (I16)."""
+        cfg = self.config
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        out = []
+        for name, graph, state in (
+            ("dis", self.dis, self.dis_state),
+            ("gan", self.gan, self.gan_state),
+            ("gen", self.gen, self.gen_params),
+            ("CV", self.cv, self.cv_state),
+        ):
+            path = os.path.join(cfg.output_dir, f"{cfg.file_prefix}_{name}_model.zip")
+            write_model(path, graph, state, save_updater=True)
+            out.append(path)
+        return out
+
+    # -- the loop (I14) --------------------------------------------------
+    def run(self, train_iterator, test_iterator=None) -> Dict:
+        cfg = self.config
+        if cfg.prefetch > 0:
+            sharding = getattr(self.dis_trainer, "batch_sharding", lambda: None)()
+            train_iterator = DevicePrefetchIterator(
+                train_iterator, depth=cfg.prefetch, sharding=sharding
+            )
+        history: List[Dict[str, float]] = []
+        with device_trace(cfg.profile_dir):
+            while train_iterator.has_next() and self.batch_counter < cfg.num_iterations:
+                t0 = time.perf_counter()
+                batch = train_iterator.next()
+                losses = self.train_iteration(batch.features, batch.labels)
+
+                index = self.batch_counter + 1
+                if self.batch_counter % cfg.print_every == 0:
+                    with self.timer.phase("export_manifold"):
+                        self.export_manifold(index)
+                if test_iterator is not None and self.batch_counter % cfg.save_every == 0:
+                    with self.timer.phase("export_predictions"):
+                        self.export_predictions(test_iterator, index)
+                if cfg.save_models:
+                    with self.timer.phase("checkpoint"):
+                        self.save_models()
+
+                elapsed = time.perf_counter() - t0
+                images = batch.num_examples()
+                losses["images_per_sec"] = images / elapsed if elapsed > 0 else 0.0
+                self.metrics.log(self.batch_counter, losses)
+                history.append(losses)
+                logger.info("Completed Batch %d!", self.batch_counter)
+                self.batch_counter += 1
+                if not train_iterator.has_next():
+                    train_iterator.reset()  # (:600-602)
+        return {
+            "iterations": self.batch_counter,
+            "history": history,
+            "timings": dict(self.timer.totals),
+        }

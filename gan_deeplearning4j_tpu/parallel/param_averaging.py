@@ -31,7 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map as _shard_map
 
 from gan_deeplearning4j_tpu.optim.optimizer import GraphOptimizer
-from gan_deeplearning4j_tpu.parallel.trainer import TrainState
+from gan_deeplearning4j_tpu.parallel.trainer import TrainState, make_train_state
 
 
 def _average_tree(tree, axis_name: str):
@@ -67,6 +67,8 @@ class ParameterAveragingTrainer:
     ):
         if averaging_frequency < 1:
             raise ValueError("averaging_frequency must be >= 1")
+        if batch_size_per_worker < 1:
+            raise ValueError("batch_size_per_worker must be >= 1")
         self.graph = graph
         self.optimizer = GraphOptimizer(graph)
         self.mesh = mesh
@@ -83,14 +85,7 @@ class ParameterAveragingTrainer:
         return self.num_workers * self.averaging_frequency * self.batch_size_per_worker
 
     def init_state(self, seed: Optional[int] = None, params: Optional[Dict] = None) -> TrainState:
-        if params is None:
-            params = self.graph.init(seed)
-        state = TrainState(
-            params=params,
-            opt_state=self.optimizer.init(params),
-            step=jnp.zeros((), jnp.int32),
-        )
-        return jax.device_put(state, NamedSharding(self.mesh, P()))
+        return make_train_state(self.graph, self.optimizer, self.mesh, seed, params)
 
     # -- the round ----------------------------------------------------------
     def _build_round(self, freq: int):

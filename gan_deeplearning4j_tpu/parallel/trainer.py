@@ -46,6 +46,23 @@ class TrainState:
         return cls(*children)
 
 
+def make_train_state(
+    graph, optimizer: GraphOptimizer, mesh=None, seed=None, params=None
+) -> TrainState:
+    """Fresh TrainState (step 0), replicated over the mesh when given —
+    shared by all trainer front ends."""
+    if params is None:
+        params = graph.init(seed)
+    state = TrainState(
+        params=params,
+        opt_state=optimizer.init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+    if mesh is not None:
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+    return state
+
+
 class GraphTrainer:
     """Single-chip or data-parallel trainer for one ComputationGraph.
 
@@ -73,16 +90,7 @@ class GraphTrainer:
 
     # -- state --------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None, params: Optional[Dict] = None) -> TrainState:
-        if params is None:
-            params = self.graph.init(seed)
-        state = TrainState(
-            params=params,
-            opt_state=self.optimizer.init(params),
-            step=jnp.zeros((), jnp.int32),
-        )
-        if self.mesh is not None:
-            state = jax.device_put(state, self._replicated())
-        return state
+        return make_train_state(self.graph, self.optimizer, self.mesh, seed, params)
 
     def _replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())

@@ -1,0 +1,9 @@
+"""Utilities: model serialization, profiling scopes, metrics logging."""
+
+from gan_deeplearning4j_tpu.utils.serializer import (
+    ModelSerializer,
+    read_model,
+    write_model,
+)
+
+__all__ = ["ModelSerializer", "read_model", "write_model"]

@@ -1,0 +1,53 @@
+"""Per-step scalar metrics (SURVEY §5 metrics/logging).
+
+The reference logs phase names but never a single loss value; quality metrics
+live offline in the notebook. Here every loop iteration emits structured
+scalars (D-loss, G-loss, CV-loss, images/sec) through the standard logger and
+optionally to a JSONL file for offline analysis — the quantitative logging
+the reference lacks, required by the bench harness anyway (SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger("gan_deeplearning4j_tpu.metrics")
+
+
+class MetricsLogger:
+    """Step-keyed scalar sink: stdlib logging + optional JSONL file."""
+
+    def __init__(self, jsonl_path: Optional[str] = None):
+        self.jsonl_path = jsonl_path
+        self._fh = None
+        if jsonl_path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)), exist_ok=True)
+            self._fh = open(jsonl_path, "a", buffering=1)
+        self.history: list = []
+
+    def log(self, step: int, scalars: Dict[str, float]) -> None:
+        record = {"step": int(step), "time": time.time()}
+        record.update({k: float(v) for k, v in scalars.items()})
+        self.history.append(record)
+        logger.info(
+            "step %d | %s",
+            step,
+            " ".join(f"{k}={v:.5g}" for k, v in record.items() if k not in ("step", "time")),
+        )
+        if self._fh is not None:
+            self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

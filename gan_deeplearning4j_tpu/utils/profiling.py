@@ -1,0 +1,65 @@
+"""Phase timing + device tracing (SURVEY §5 tracing/profiling).
+
+The reference's only observability is CUDA-backend verbosity and phase-named
+log lines ("Training discriminator!" etc., dl4jGANComputerVision.java:424,
+469,515). Here each phase of the training loop runs inside a timing scope,
+and ``device_trace`` wraps ``jax.profiler.trace`` for TensorBoard/Perfetto
+captures of the XLA timeline when deeper inspection is needed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase across loop iterations."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, block_until_ready=None) -> Iterator[None]:
+        """Time one phase. Pass the phase's output arrays as
+        ``block_until_ready`` to include device execution, not just dispatch
+        (XLA is async: without a sync the scope measures Python only)."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block_until_ready is not None:
+                jax.block_until_ready(block_until_ready)
+            elapsed = time.perf_counter() - start
+            self.totals[name] += elapsed
+            self.counts[name] += 1
+
+    def mean(self, name: str) -> float:
+        c = self.counts.get(name, 0)
+        return self.totals[name] / c if c else 0.0
+
+    def report(self) -> str:
+        rows = sorted(self.totals.items(), key=lambda kv: -kv[1])
+        return "\n".join(
+            f"{name:>24s}: total {total:8.3f}s  mean {self.mean(name)*1e3:8.2f}ms  n={self.counts[name]}"
+            for name, total in rows
+        )
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture an XLA device trace under ``log_dir`` (viewable in
+    TensorBoard's profile tab / Perfetto). No-op when ``log_dir`` is None."""
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

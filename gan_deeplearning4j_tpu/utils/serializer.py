@@ -1,0 +1,143 @@
+"""Model checkpointing — the ``ModelSerializer`` analog (SURVEY §2.2 D12, §5).
+
+The reference saves all four models every loop iteration as zips with updater
+state included: ``ModelSerializer.writeModel(model, file, saveUpdater=true)``
+(dl4jGANComputerVision.java:605-619). Restore is never exercised there but the
+format implies it; here both directions exist.
+
+Checkpoint = one zip holding:
+- ``topology.json`` — the graph config/topology (``ComputationGraph.to_dict``),
+  enough to rebuild the graph without the defining code path;
+- ``arrays.npz`` — every named parameter and (optionally) per-layer updater
+  state, flattened to ``params/<layer>/<name>`` / ``updater/<layer>/<param>/
+  <slot>`` keys;
+- ``meta.json`` — step counter + format version.
+
+Arrays cross to host exactly once per save (one batched ``jax.device_get``),
+not per-parameter — the scalar-read-per-value pathology the reference's CSV
+export has (SURVEY §3.3 hot loop 3) is avoided at every host boundary here.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tempfile
+import zipfile
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _flatten(prefix: str, tree: Dict, out: Dict[str, np.ndarray]) -> None:
+    for key, value in tree.items():
+        path = f"{prefix}/{key}"
+        if isinstance(value, dict):
+            _flatten(path, value, out)
+        else:
+            out[path] = value
+
+
+def _unflatten(flat: Dict[str, np.ndarray], prefix: str) -> Dict:
+    tree: Dict = {}
+    plen = len(prefix) + 1
+    for path, value in flat.items():
+        if not path.startswith(prefix + "/"):
+            continue
+        node = tree
+        parts = path[plen:].split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = jnp.asarray(value)
+    return tree
+
+
+def write_model(path: str, graph, state, save_updater: bool = True) -> None:
+    """Serialize graph topology + params (+ updater state) to ``path``.
+
+    ``state`` is a TrainState, or a bare params dict (then there is no
+    updater state regardless of ``save_updater``).
+    """
+    params = getattr(state, "params", state)
+    opt_state = getattr(state, "opt_state", None) if save_updater else None
+    step = getattr(state, "step", None)
+
+    arrays: Dict[str, np.ndarray] = {}
+    _flatten("params", params, arrays)
+    if opt_state is not None:
+        _flatten("updater", opt_state, arrays)
+    arrays = jax.device_get(arrays)  # one batched device->host transfer
+
+    npz_buf = io.BytesIO()
+    np.savez(npz_buf, **arrays)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "step": int(step) if step is not None else 0,
+        "has_updater": opt_state is not None,
+    }
+
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    # write-then-rename so a crash mid-save never corrupts the previous
+    # checkpoint (the per-iteration overwrite pattern of the reference)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
+                zf.writestr("topology.json", json.dumps(graph.to_dict()))
+                zf.writestr("meta.json", json.dumps(meta))
+                zf.writestr("arrays.npz", npz_buf.getvalue())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def read_model(path: str, load_updater: bool = True) -> Tuple[object, Dict, Optional[Dict], int]:
+    """Load a checkpoint: returns (graph, params, opt_state_or_None, step).
+
+    The graph is rebuilt from the stored topology, so a checkpoint is
+    self-contained (restorable without the code that defined the model)."""
+    from gan_deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    with zipfile.ZipFile(path, "r") as zf:
+        topology = json.loads(zf.read("topology.json"))
+        meta = json.loads(zf.read("meta.json"))
+        if meta["format_version"] > FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format {meta['format_version']} is newer than "
+                f"supported {FORMAT_VERSION}"
+            )
+        with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
+            flat = {k: npz[k] for k in npz.files}
+
+    graph = ComputationGraph.from_dict(topology)
+    params = _unflatten(flat, "params")
+    opt_state = None
+    if load_updater and meta["has_updater"]:
+        opt_state = _unflatten(flat, "updater")
+    return graph, params, opt_state, meta["step"]
+
+
+class ModelSerializer:
+    """DL4J-shaped static facade (``ModelSerializer.writeModel/restore``)."""
+
+    write_model = staticmethod(write_model)
+    read_model = staticmethod(read_model)
+
+    @staticmethod
+    def restore_train_state(path: str, trainer):
+        """Rebuild a trainer-ready TrainState from a checkpoint (resume — the
+        capability the reference's format implies but never calls)."""
+        from gan_deeplearning4j_tpu.parallel.trainer import TrainState
+
+        _, params, opt_state, step = read_model(path)
+        if opt_state is None:
+            opt_state = trainer.optimizer.init(params)
+        return TrainState(params, opt_state, jnp.asarray(step, jnp.int32))

@@ -1,0 +1,158 @@
+"""End-to-end harness tests: the alternating GAN loop on small synthetic
+MNIST — the SURVEY §4 acceptance slice (shapes, weight-sync coherence,
+exports, checkpoints), on the CPU fake mesh."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.data import ArrayDataSetIterator
+from gan_deeplearning4j_tpu.data.dataset import one_hot_np
+from gan_deeplearning4j_tpu.data.mnist import synthetic_mnist
+from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+from gan_deeplearning4j_tpu.harness.experiment import latent_grid
+from gan_deeplearning4j_tpu.utils import read_model
+
+
+def tiny_config(tmp_path, **overrides) -> ExperimentConfig:
+    base = dict(
+        batch_size_train=16,
+        batch_size_pred=32,
+        num_iterations=2,
+        latent_grid=4,
+        data_dir=str(tmp_path / "data"),
+        output_dir=str(tmp_path / "out"),
+        save_models=True,
+    )
+    base.update(overrides)
+    return ExperimentConfig(**base)
+
+
+def iterators(batch_train=16, batch_pred=32, n_train=64, n_test=32):
+    (xtr, ytr), (xte, yte) = synthetic_mnist(n_train, n_test)
+    train = ArrayDataSetIterator(xtr, one_hot_np(ytr, 10), batch_size=batch_train)
+    test = ArrayDataSetIterator(xte, one_hot_np(yte, 10), batch_size=batch_pred)
+    return train, test
+
+
+class TestLatentGrid:
+    def test_grid_layout(self):
+        g = latent_grid(10, 2)
+        assert g.shape == (100, 2)
+        assert g.min() == -1.0 and g.max() == 1.0
+        g3 = latent_grid(4, 3)
+        assert g3.shape == (16, 3)
+        np.testing.assert_array_equal(g3[:, 2], 0.0)
+
+
+class TestConfig:
+    def test_defaults_match_reference(self):
+        c = ExperimentConfig()
+        assert (c.batch_size_train, c.batch_size_pred) == (200, 500)
+        assert (c.num_features, c.num_classes, c.num_classes_dis) == (784, 10, 1)
+        assert c.num_iterations == 2 and c.z_size == 2 and c.seed == 666
+        assert (c.dis_learning_rate, c.gen_learning_rate, c.frozen_learning_rate) == (
+            0.002, 0.004, 0.0,
+        )
+        assert c.averaging_frequency == 10 and c.batch_size_per_worker == 200
+
+    def test_cli_and_json_overrides(self, tmp_path):
+        c = ExperimentConfig.from_args(["--num-iterations", "5", "--seed", "1"])
+        assert c.num_iterations == 5 and c.seed == 1
+        p = tmp_path / "c.json"
+        ExperimentConfig(num_iterations=7).to_json(str(p))
+        c2 = ExperimentConfig.from_args(["--config", str(p), "--seed", "3"])
+        assert c2.num_iterations == 7 and c2.seed == 3
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ExperimentConfig(num_features=100).validate()
+        with pytest.raises(ValueError):
+            ExperimentConfig(distributed="spark").validate()
+
+
+class TestExperimentLoop:
+    def test_two_iterations_end_to_end(self, tmp_path):
+        cfg = tiny_config(tmp_path)
+        exp = GanExperiment(cfg)
+        train, test = iterators()
+        result = exp.run(train, test)
+        assert result["iterations"] == 2
+        for h in result["history"]:
+            assert np.isfinite([h["d_loss"], h["g_loss"], h["cv_loss"]]).all()
+        # exports exist with the right shapes
+        manifold = np.loadtxt(
+            os.path.join(cfg.output_dir, "mnist_out_1.csv"), delimiter=","
+        )
+        assert manifold.shape == (16, 784)
+        assert manifold.min() >= 0.0 and manifold.max() <= 1.0  # sigmoid output
+        preds = np.loadtxt(
+            os.path.join(cfg.output_dir, "mnist_test_predictions_1.csv"), delimiter=","
+        )
+        assert preds.shape == (32, 10)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)  # softmax rows
+        # all four checkpoints restorable
+        for name in ("dis", "gan", "gen", "CV"):
+            path = os.path.join(cfg.output_dir, f"mnist_{name}_model.zip")
+            graph, params, _, _ = read_model(path)
+            assert params
+
+    def test_weight_sync_coherence(self, tmp_path):
+        """After an iteration: gan frozen tail == dis, gen == gan generator
+        layers, cv features == dis features — the invariant the reference's
+        38 setParam calls maintain (:429-542)."""
+        from gan_deeplearning4j_tpu.models.dcgan_mnist import (
+            DIS_TO_CV, DIS_TO_GAN, GAN_TO_GEN,
+        )
+
+        cfg = tiny_config(tmp_path, num_iterations=1, save_models=False)
+        exp = GanExperiment(cfg)
+        train, _ = iterators()
+        exp.run(train)
+        for src, dst in GAN_TO_GEN.items():
+            for pname, v in exp.gan_state.params[src].items():
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(exp.gen_params[dst][pname])
+                )
+        # cv features were synced BEFORE the cv fit: weights stay equal
+        # (frozen, LR 0) but cv-side BN stats advance during its own step
+        for src, dst in DIS_TO_CV.items():
+            roles = exp.dis.vertex(src).layer.param_roles()
+            for pname, v in exp.dis_state.params[src].items():
+                if roles.get(pname) == "state":
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(exp.cv_state.params[dst][pname])
+                )
+        # gan tail was synced BEFORE the gan step; the frozen tail's LR is 0
+        # so weights stayed equal, but its BN running stats advanced during
+        # the gan step — weights equal, stats differ (SURVEY §7 hard parts)
+        for src, dst in DIS_TO_GAN.items():
+            roles = exp.dis.vertex(src).layer.param_roles()
+            for pname, v in exp.dis_state.params[src].items():
+                if roles.get(pname) == "state":
+                    continue
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(exp.gan_state.params[dst][pname])
+                )
+
+    def test_label_noise_reference_quirk(self, tmp_path):
+        cfg = tiny_config(tmp_path)
+        exp = GanExperiment(cfg)
+        eps1 = exp._eps_real.copy()
+        exp.train_iteration(*_one_batch())
+        np.testing.assert_array_equal(exp._eps_real, eps1)  # sampled once, reused
+
+    def test_distributed_pmean_mode(self, tmp_path):
+        cfg = tiny_config(tmp_path, distributed="pmean", save_models=False, num_iterations=1)
+        exp = GanExperiment(cfg)
+        train, _ = iterators()
+        result = exp.run(train)
+        assert result["iterations"] == 1
+        assert np.isfinite(result["history"][0]["d_loss"])
+
+
+def _one_batch(n=16):
+    (xtr, ytr), _ = synthetic_mnist(n, 1)
+    return xtr, one_hot_np(ytr, 10)
