@@ -1,0 +1,72 @@
+"""Headline benchmark: DCGAN-MNIST alternating-loop throughput (images/sec/chip).
+
+Runs the reference topology (dl4jGANComputerVision.java:117-314) at batch 64
+(BASELINE.json config 1) through the full alternating iteration — dis fit,
+weight sync, gan fit, sync, classifier fit — on whatever device jax provides,
+and prints ONE JSON line. The reference publishes no numbers (BASELINE.md), so
+this run *establishes* the baseline; vs_baseline is reported against the
+recorded target in this file once one exists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# First recorded real-TPU number for this config becomes the baseline to beat.
+# None until a driver run on real hardware records one.
+BASELINE_IMAGES_PER_SEC = None
+
+WARMUP_ITERS = 3
+TIMED_ITERS = 20
+BATCH = 64
+
+
+def main() -> None:
+    from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
+    from gan_deeplearning4j_tpu.harness.experiment import GanExperiment
+
+    cfg = ExperimentConfig(
+        batch_size_train=BATCH,
+        batch_size_pred=BATCH,
+        num_iterations=WARMUP_ITERS + TIMED_ITERS,
+        save_models=False,
+    )
+    exp = GanExperiment(cfg)
+
+    rng = np.random.default_rng(0)
+    features = rng.random((BATCH, cfg.num_features), dtype=np.float32)
+    labels = np.eye(cfg.num_classes, dtype=np.float32)[
+        rng.integers(0, cfg.num_classes, size=BATCH)
+    ]
+
+    for _ in range(WARMUP_ITERS):
+        exp.train_iteration(features, labels)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        exp.train_iteration(features, labels)
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec = TIMED_ITERS * BATCH / elapsed
+    vs = (
+        images_per_sec / BASELINE_IMAGES_PER_SEC
+        if BASELINE_IMAGES_PER_SEC
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "dcgan_mnist_images_per_sec_per_chip",
+                "value": round(images_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
