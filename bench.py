@@ -42,12 +42,16 @@ def main() -> None:
         rng.integers(0, cfg.num_classes, size=BATCH)
     ]
 
+    import jax
+
     for _ in range(WARMUP_ITERS):
-        exp.train_iteration(features, labels)
+        losses = exp.train_iteration(features, labels)
+    jax.block_until_ready(losses)
 
     t0 = time.perf_counter()
     for _ in range(TIMED_ITERS):
-        exp.train_iteration(features, labels)
+        losses = exp.train_iteration(features, labels)
+    jax.block_until_ready(losses)  # iterations pipeline; settle before timing
     elapsed = time.perf_counter() - t0
 
     images_per_sec = TIMED_ITERS * BATCH / elapsed

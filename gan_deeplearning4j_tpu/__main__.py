@@ -34,13 +34,20 @@ def main(argv=None) -> int:
     )
     print("Program arguments:", sys.argv[1:] if argv is None else argv)
     config = ExperimentConfig.from_args(argv)
+    if not config.use_accelerator:
+        # the useGpu=false path (dl4jGANComputerVision.java:92): run on host.
+        # Must happen before the backend initializes; jax.config wins over the
+        # JAX_PLATFORMS env var on this image.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     print("Execution backend:", backend_info())
 
     train_csv = os.path.join(config.data_dir, f"{config.file_prefix}_train.csv")
     test_csv = os.path.join(config.data_dir, f"{config.file_prefix}_test.csv")
     if not (os.path.exists(train_csv) and os.path.exists(test_csv)):
         print(f"No CSVs under {config.data_dir!r}; generating synthetic MNIST there.")
-        prepare_mnist(config.data_dir)
+        prepare_mnist(config.data_dir, prefix=config.file_prefix)
 
     train_it = _csv_iterator(
         train_csv, config.batch_size_train, config.num_features, config.num_classes

@@ -117,15 +117,16 @@ def prepare_mnist(
     num_test: int = 500,
     seed: int = 666,
     source: Optional[str] = None,
+    prefix: str = "mnist",
 ) -> Tuple[str, str]:
     """End-to-end cell-2 analog: obtain MNIST (real CSVs under ``source`` if
-    present, else synthetic), write ``mnist_train.csv`` + ``mnist_test.csv``
+    present, else synthetic), write ``{prefix}_train.csv`` + ``{prefix}_test.csv``
     (+ the stratified sample) under ``out_dir``; returns the two paths."""
-    train_path = os.path.join(out_dir, "mnist_train.csv")
-    test_path = os.path.join(out_dir, "mnist_test.csv")
+    train_path = os.path.join(out_dir, f"{prefix}_train.csv")
+    test_path = os.path.join(out_dir, f"{prefix}_test.csv")
     if source is not None:
-        src_train = os.path.join(source, "mnist_train.csv")
-        src_test = os.path.join(source, "mnist_test.csv")
+        src_train = os.path.join(source, f"{prefix}_train.csv")
+        src_test = os.path.join(source, f"{prefix}_test.csv")
         if os.path.exists(src_train) and os.path.exists(src_test):
             xtr, ytr = load_mnist_csv(src_train)
             xte, yte = load_mnist_csv(src_test)
@@ -136,5 +137,5 @@ def prepare_mnist(
     write_mnist_csv(train_path, xtr, ytr)
     write_mnist_csv(test_path, xte, yte)
     xs, ys = stratified_sample(xtr, ytr, per_class=100, seed=seed)
-    write_mnist_csv(os.path.join(out_dir, "sampled_mnist_train.csv"), xs, ys)
+    write_mnist_csv(os.path.join(out_dir, f"sampled_{prefix}_train.csv"), xs, ys)
     return train_path, test_path

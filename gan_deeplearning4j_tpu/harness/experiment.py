@@ -115,6 +115,23 @@ class GanExperiment:
         self.timer = PhaseTimer()
         self.metrics = MetricsLogger(cfg.metrics_jsonl)
         self.batch_counter = 0
+        self._soft_cache: Dict[int, tuple] = {}
+
+        # With plain GraphTrainers (single-chip or per-step pmean) the whole
+        # alternating iteration fuses into ONE compiled XLA program: the three
+        # fits run back to back in HBM and the reference's 38 setParam copies
+        # (:429-542) become pure pytree rewiring — zero device copies, one
+        # dispatch per iteration instead of ~10 (crucial when each dispatch
+        # pays host↔TPU latency). Parameter-averaging mode keeps the phased
+        # path, since its fit has its own shard_map program.
+        self._fused = (
+            self._build_fused_iteration()
+            if all(
+                isinstance(t, GraphTrainer)
+                for t in (self.dis_trainer, self.gan_trainer, self.cv_trainer)
+            )
+            else None
+        )
 
     # ------------------------------------------------------------------
     def _make_trainer(self, graph: ComputationGraph):
@@ -160,42 +177,183 @@ class GanExperiment:
             dst_state.step,
         )
 
+    def _build_fused_iteration(self):
+        """Jit the full alternating iteration (§3.2 steps a–f) as one program."""
+        gen_graph = self.gen
+
+        def one_step(graph, opt, state: TrainState, feats, labels):
+            def loss_fn(p):
+                loss, (_, new_p) = graph.loss(
+                    p, feats, labels, train=True, rng=jax.random.PRNGKey(0)
+                )
+                return loss, new_p
+
+            (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            params, opt_state = opt.step(new_params, grads, state.opt_state)
+            return TrainState(params, opt_state, state.step + 1), loss
+
+        def rebind(src: TrainState, dst: TrainState, mapping) -> TrainState:
+            return TrainState(
+                ComputationGraph.copy_params(src.params, dst.params, mapping),
+                dst.opt_state,
+                dst.step,
+            )
+
+        z_size = self.model_cfg.z_size
+        base_key = jax.random.PRNGKey(self.config.seed + 2)
+
+        def fused(
+            dis_state, gan_state, cv_state, gen_params,
+            real_f, real_l, soft1, soft0,
+        ):
+            # z ~ U(−1,1) drawn on device (rand·2−1, :420,465), keyed off the
+            # step counter — no host RNG round trip per iteration
+            b = real_f.shape[0]
+            key = jax.random.fold_in(base_key, dis_state.step)
+            k_fake, k_gan = jax.random.split(key)
+            z_fake = jax.random.uniform(k_fake, (b, z_size), jnp.float32, -1.0, 1.0)
+            z_gan = jax.random.uniform(k_gan, (b, z_size), jnp.float32, -1.0, 1.0)
+            # (a) fake batch from the frozen sampler
+            fake = gen_graph.output(gen_params, z_fake, train=False)
+            fake = fake.reshape(real_f.shape)
+            # (b) dis fit: real→soft1 then fake→soft0, two optimizer steps
+            dis_state, d1 = one_step(
+                self.dis, self.dis_trainer.optimizer, dis_state, real_f, soft1
+            )
+            dis_state, d2 = one_step(
+                self.dis, self.dis_trainer.optimizer, dis_state, fake, soft0
+            )
+            # (c) dis → gan frozen tail
+            gan_state = rebind(dis_state, gan_state, dcgan_mnist.DIS_TO_GAN)
+            # (d) generator step through the frozen D on [z, ones]
+            ones = jnp.ones((z_gan.shape[0], 1), jnp.float32)
+            gan_state, g = one_step(
+                self.gan, self.gan_trainer.optimizer, gan_state, z_gan, ones
+            )
+            # (e) gan → gen refresh; dis → classifier features
+            gen_params = ComputationGraph.copy_params(
+                gan_state.params, gen_params, dcgan_mnist.GAN_TO_GEN
+            )
+            cv_state = rebind(dis_state, cv_state, dcgan_mnist.DIS_TO_CV)
+            # (f) classifier step on the real labeled batch
+            cv_state, c = one_step(
+                self.cv, self.cv_trainer.optimizer, cv_state, real_f, real_l
+            )
+            return dis_state, gan_state, cv_state, gen_params, (d1 + d2) / 2.0, g, c
+
+        kwargs = {"donate_argnums": (0, 1, 2, 3)}
+        if self.mesh is not None:
+            rep = jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
+            data = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec("data")
+            )
+            kwargs["in_shardings"] = (rep,) * 4 + (data,) * 4
+            kwargs["out_shardings"] = (rep,) * 7
+        return jax.jit(fused, **kwargs)
+
+    def _fit_batch(self, trainer, state, features, labels, batch_size: int):
+        """One fit on one in-memory batch. GraphTrainer takes the device
+        arrays straight into its jitted step (no host hop); the
+        parameter-averaging trainer keeps its iterator surface."""
+        if isinstance(trainer, GraphTrainer):
+            state, loss = trainer.train_step(state, features, labels)
+            return state, [loss]
+        it = ArrayDataSetIterator(
+            np.asarray(features), np.asarray(labels), batch_size=batch_size
+        )
+        return trainer.fit(state, it)
+
     # ------------------------------------------------------------------
-    def train_iteration(self, real_features, real_labels) -> Dict[str, float]:
+    def train_iteration(self, real_features, real_labels) -> Dict:
         """One full alternating iteration (§3.2). Inputs are the real batch:
-        features (B, num_features) in [0,1] and one-hot labels (B, classes)."""
+        features (B, num_features) in [0,1] and one-hot labels (B, classes).
+
+        Everything stays in HBM between phases: the fake batch, the dis/gan/cv
+        minibatches, and the weight syncs are device arrays end to end. In
+        fused mode the returned losses are *device scalars* (no sync — back-to-
+        back iterations pipeline); in parameter-averaging mode they are host
+        floats. ``run()`` normalizes to floats before logging."""
         cfg = self.config
         b = int(real_features.shape[0])
         eps_r, eps_f = self._eps_real[:b], self._eps_fake[:b]
         if cfg.resample_label_noise:
             eps_r, eps_f = self._soft_noise(b), self._soft_noise(b)
+        real_features = jnp.asarray(real_features)
+        real_labels = jnp.asarray(real_labels)
+
+        if self._fused is not None:
+            if cfg.resample_label_noise:
+                soft1 = jnp.asarray(1.0 + eps_r)
+                soft0 = jnp.asarray(0.0 + eps_f)
+            else:
+                # fixed softened labels live in HBM once, keyed by batch size
+                if b not in self._soft_cache:
+                    self._soft_cache[b] = (
+                        jnp.asarray(1.0 + eps_r),
+                        jnp.asarray(0.0 + eps_f),
+                    )
+                soft1, soft0 = self._soft_cache[b]
+            with self.timer.phase("train_fused"):
+                (
+                    self.dis_state,
+                    self.gan_state,
+                    self.cv_state,
+                    self.gen_params,
+                    d_loss,
+                    g_loss,
+                    cv_loss,
+                ) = self._fused(
+                    self.dis_state, self.gan_state, self.cv_state, self.gen_params,
+                    real_features, real_labels, soft1, soft0,
+                )
+            # losses stay on device — the reference never logs losses at all
+            # (SURVEY §5), so don't stall the pipeline; callers float() lazily
+            return {"d_loss": d_loss, "g_loss": g_loss, "cv_loss": cv_loss}
 
         # (a) fake batch from the frozen sampler
-        with self.timer.phase("sample_fake"):
+        with self.timer.phase("sample_fake") as sink:
             fake = self._gen_fwd(self.gen_params, jnp.asarray(self._sample_z(b)))
             fake = fake.reshape(b, cfg.num_features)
+            sink.append(fake)
 
-        # (b) discriminator step: [real→soft 1, fake→soft 0] as two
-        # minibatches, exactly the reference's 2-element List<DataSet> (:414-421)
-        with self.timer.phase("train_dis"):
-            dis_feats = jnp.concatenate([jnp.asarray(real_features), fake], axis=0)
-            dis_labels = jnp.concatenate(
-                [1.0 + jnp.asarray(eps_r), 0.0 + jnp.asarray(eps_f)], axis=0
-            )
-            it = ArrayDataSetIterator(
-                np.asarray(dis_feats), np.asarray(dis_labels), batch_size=b
-            )
-            self.dis_state, d_losses = self.dis_trainer.fit(self.dis_state, it)
+        # (b) discriminator fit on [real→soft 1, fake→soft 0] — two
+        # minibatches in order, exactly the reference's 2-element
+        # List<DataSet> (:414-421), i.e. two optimizer steps
+        with self.timer.phase("train_dis") as sink:
+            d_losses = []
+            if isinstance(self.dis_trainer, GraphTrainer):
+                # two jitted steps, one compiled shape (batch b), data in HBM
+                for feats, labels in (
+                    (real_features, 1.0 + jnp.asarray(eps_r)),
+                    (fake, 0.0 + jnp.asarray(eps_f)),
+                ):
+                    self.dis_state, loss = self.dis_trainer.train_step(
+                        self.dis_state, feats, labels
+                    )
+                    d_losses.append(loss)
+            else:
+                # the averaging trainer takes both minibatches in one fit,
+                # like the reference's 2-element RDD
+                feats = np.concatenate([np.asarray(real_features), np.asarray(fake)])
+                labels = np.concatenate([1.0 + eps_r, 0.0 + eps_f])
+                self.dis_state, d_losses = self.dis_trainer.fit(
+                    self.dis_state, ArrayDataSetIterator(feats, labels, batch_size=b)
+                )
+            sink.extend(d_losses)
 
         # (c) dis → gan frozen tail (:429-460)
         self.gan_state = self._sync(self.dis_state, self.gan_state, dcgan_mnist.DIS_TO_GAN)
 
         # (d) generator step through the frozen D: [z, ones] (:462-471)
-        with self.timer.phase("train_gan"):
-            z = self._sample_z(b)
-            ones = np.ones((b, 1), np.float32)
-            it = ArrayDataSetIterator(z, ones, batch_size=b)
-            self.gan_state, g_losses = self.gan_trainer.fit(self.gan_state, it)
+        with self.timer.phase("train_gan") as sink:
+            z = jnp.asarray(self._sample_z(b))
+            ones = jnp.ones((b, 1), jnp.float32)
+            self.gan_state, g_losses = self._fit_batch(
+                self.gan_trainer, self.gan_state, z, ones, b
+            )
+            sink.extend(g_losses)
 
         # (e) gan → gen refresh (:473-510); dis → classifier features (:512-542)
         self.gen_params = ComputationGraph.copy_params(
@@ -206,16 +364,16 @@ class GanExperiment:
         self.cv_state = self._sync(self.dis_state, self.cv_state, dcgan_mnist.DIS_TO_CV)
 
         # (f) classifier step on the real labeled batch (:544-545)
-        with self.timer.phase("train_cv"):
-            it = ArrayDataSetIterator(
-                np.asarray(real_features), np.asarray(real_labels), batch_size=b
+        with self.timer.phase("train_cv") as sink:
+            self.cv_state, cv_losses = self._fit_batch(
+                self.cv_trainer, self.cv_state, real_features, real_labels, b
             )
-            self.cv_state, cv_losses = self.cv_trainer.fit(self.cv_state, it)
+            sink.extend(cv_losses)
 
         return {
-            "d_loss": float(np.mean(d_losses)) if d_losses else float("nan"),
-            "g_loss": float(np.mean(g_losses)) if g_losses else float("nan"),
-            "cv_loss": float(np.mean(cv_losses)) if cv_losses else float("nan"),
+            "d_loss": float(np.mean([float(l) for l in d_losses])) if d_losses else float("nan"),
+            "g_loss": float(np.mean([float(l) for l in g_losses])) if g_losses else float("nan"),
+            "cv_loss": float(np.mean([float(l) for l in cv_losses])) if cv_losses else float("nan"),
         }
 
     # -- exports (I15) --------------------------------------------------
@@ -277,6 +435,10 @@ class GanExperiment:
                 t0 = time.perf_counter()
                 batch = train_iterator.next()
                 losses = self.train_iteration(batch.features, batch.labels)
+                # normalize device scalars to host floats HERE, inside the
+                # timed window, so images_per_sec includes device execution
+                # rather than XLA dispatch only
+                losses = {k: float(v) for k, v in losses.items()}
 
                 index = self.batch_counter + 1
                 if self.batch_counter % cfg.print_every == 0:

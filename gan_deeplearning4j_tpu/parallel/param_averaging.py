@@ -88,9 +88,8 @@ class ParameterAveragingTrainer:
         return make_train_state(self.graph, self.optimizer, self.mesh, seed, params)
 
     # -- the round ----------------------------------------------------------
-    def _build_round(self, freq: int):
+    def _build_round(self, freq: int, b: int):
         axis = self.data_axis
-        b = self.batch_size_per_worker
 
         def local_fit(state: TrainState, feats, labels, rng):
             """One worker's local fit: ``freq`` sequential optimizer steps on
@@ -138,70 +137,82 @@ class ParameterAveragingTrainer:
         return jax.jit(mapped, donate_argnums=(0,))
 
     def fit_round(
-        self, state: TrainState, features, labels, rng=None, freq: Optional[int] = None
+        self,
+        state: TrainState,
+        features,
+        labels,
+        rng=None,
+        freq: Optional[int] = None,
+        batch_size: Optional[int] = None,
     ) -> Tuple[TrainState, jnp.ndarray]:
         """Run one averaging round on ``workers × freq × batch`` rows laid out
-        worker-major on axis 0. Returns (state, per-local-step mean losses)."""
+        worker-major on axis 0. Returns (state, per-local-step mean losses).
+        ``batch_size`` overrides the per-worker batch for tail rounds."""
         freq = self.averaging_frequency if freq is None else freq
-        expected = self.num_workers * freq * self.batch_size_per_worker
+        b = self.batch_size_per_worker if batch_size is None else batch_size
+        expected = self.num_workers * freq * b
         if features.shape[0] != expected or labels.shape[0] != expected:
             raise ValueError(
                 f"round expects {expected} rows "
-                f"({self.num_workers} workers × {freq} × {self.batch_size_per_worker}), "
+                f"({self.num_workers} workers × {freq} × {b}), "
                 f"got features {features.shape[0]} / labels {labels.shape[0]}"
             )
         if rng is None:
             rng = jax.random.PRNGKey(int(state.step))
-        if freq not in self._round_fns:
-            self._round_fns[freq] = self._build_round(freq)
-        return self._round_fns[freq](state, features, labels, rng)
+        if (freq, b) not in self._round_fns:
+            self._round_fns[(freq, b)] = self._build_round(freq, b)
+        return self._round_fns[(freq, b)](state, features, labels, rng)
 
-    # -- iterator front end --------------------------------------------------
+    @staticmethod
+    def _worker_major(arr: np.ndarray, freq: int, workers: int, b: int) -> np.ndarray:
+        """Regroup a row-major stream into worker-major (worker, freq, b)
+        order so each mesh shard sees a contiguous run of minibatches."""
+        used = freq * workers * b
+        return (
+            arr[:used]
+            .reshape((freq, workers, b) + arr.shape[1:])
+            .swapaxes(0, 1)
+            .reshape((used,) + arr.shape[1:])
+        )
+
     def fit(
         self, state: TrainState, iterator, rng=None
     ) -> Tuple[TrainState, List[float]]:
         """Consume a DataSetIterator in averaging rounds (the
         ``sparkGraph.fit(rdd)`` surface). Full rounds run at exactly
-        ``averaging_frequency``; the tail runs one shorter round, and only
-        rows that can't fill one minibatch per worker are dropped."""
+        ``averaging_frequency``; leftovers run as one tail round at reduced
+        frequency and/or reduced per-worker batch. A final ragged tail is
+        padded by cycling its own rows so every example trains — no data is
+        silently dropped (DL4J likewise trains uneven worker splits)."""
         losses: List[float] = []
         if rng is None:
             rng = jax.random.PRNGKey(int(state.step))
         rows = self.num_workers * self.batch_size_per_worker
         # chunk lists, concatenated only when a round's worth has accumulated
-        # (no per-batch full-buffer recopies; np.asarray on a jax array is a
-        # single device->host fetch only when the source isn't already host)
         buf_f: List[np.ndarray] = []
         buf_l: List[np.ndarray] = []
         buffered = 0
 
-        def run_rounds(state, rng, tail: bool):
+        def run_round(state, rng, feats, labs, freq, b):
+            f = self._worker_major(feats, freq, self.num_workers, b)
+            l = self._worker_major(labs, freq, self.num_workers, b)
+            rng, sub = jax.random.split(rng)
+            state, round_losses = self.fit_round(
+                state, jnp.asarray(f), jnp.asarray(l), sub, freq, b
+            )
+            losses.extend(float(x) for x in round_losses)
+            return state, rng
+
+        def drain_full(state, rng):
             nonlocal buf_f, buf_l, buffered
             feats = np.concatenate(buf_f, axis=0) if len(buf_f) > 1 else buf_f[0]
             labs = np.concatenate(buf_l, axis=0) if len(buf_l) > 1 else buf_l[0]
-            while feats.shape[0] >= (rows if tail else self.round_examples):
-                freq = (
-                    feats.shape[0] // rows if tail else self.averaging_frequency
+            while feats.shape[0] >= self.round_examples:
+                used = self.round_examples
+                state, rng = run_round(
+                    state, rng, feats, labs,
+                    self.averaging_frequency, self.batch_size_per_worker,
                 )
-                used = freq * rows
-                # regroup row-major stream into worker-major (worker, freq, b)
-                f = (
-                    feats[:used]
-                    .reshape((freq, self.num_workers, self.batch_size_per_worker) + feats.shape[1:])
-                    .swapaxes(0, 1)
-                    .reshape((used,) + feats.shape[1:])
-                )
-                l = (
-                    labs[:used]
-                    .reshape((freq, self.num_workers, self.batch_size_per_worker) + labs.shape[1:])
-                    .swapaxes(0, 1)
-                    .reshape((used,) + labs.shape[1:])
-                )
-                rng, sub = jax.random.split(rng)
-                state, round_losses = self.fit_round(
-                    state, jnp.asarray(f), jnp.asarray(l), sub, freq
-                )
-                losses.extend(float(x) for x in round_losses)
                 feats, labs = feats[used:], labs[used:]
             buf_f = [feats] if feats.shape[0] else []
             buf_l = [labs] if labs.shape[0] else []
@@ -214,7 +225,26 @@ class ParameterAveragingTrainer:
             buf_l.append(np.asarray(batch.labels))
             buffered += batch.num_examples()
             if buffered >= self.round_examples:
-                state, rng = run_rounds(state, rng, tail=False)
-        if buffered >= rows:
-            state, rng = run_rounds(state, rng, tail=True)
+                state, rng = drain_full(state, rng)
+
+        if buffered > 0:
+            feats = np.concatenate(buf_f, axis=0) if len(buf_f) > 1 else buf_f[0]
+            labs = np.concatenate(buf_l, axis=0) if len(buf_l) > 1 else buf_l[0]
+            n = feats.shape[0]
+            # shorter-frequency tail at the standard per-worker batch
+            freq = n // rows
+            if freq >= 1:
+                used = freq * rows
+                state, rng = run_round(
+                    state, rng, feats, labs, freq, self.batch_size_per_worker
+                )
+                feats, labs, n = feats[used:], labs[used:], n - used
+            # ragged tail: shrink the per-worker batch and pad by cycling
+            if n > 0:
+                b = max(1, -(-n // self.num_workers))  # ceil
+                need = self.num_workers * b
+                if need > n:
+                    idx = np.arange(need) % n
+                    feats, labs = feats[idx], labs[idx]
+                state, rng = run_round(state, rng, feats, labs, 1, b)
         return state, losses

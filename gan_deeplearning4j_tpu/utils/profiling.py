@@ -28,16 +28,19 @@ class PhaseTimer:
         self.counts: Dict[str, int] = defaultdict(int)
 
     @contextlib.contextmanager
-    def phase(self, name: str, block_until_ready=None) -> Iterator[None]:
-        """Time one phase. Pass the phase's output arrays as
-        ``block_until_ready`` to include device execution, not just dispatch
-        (XLA is async: without a sync the scope measures Python only)."""
+    def phase(self, name: str) -> Iterator[list]:
+        """Time one phase. The scope yields a sink list: append the phase's
+        output arrays to it and the timer blocks on them before stopping the
+        clock, so device execution is billed to this phase rather than to
+        whichever later phase happens to synchronize (XLA dispatch is async —
+        without a sync the scope measures Python only)."""
+        sink: list = []
         start = time.perf_counter()
         try:
-            yield
+            yield sink
         finally:
-            if block_until_ready is not None:
-                jax.block_until_ready(block_until_ready)
+            if sink:
+                jax.block_until_ready(sink)
             elapsed = time.perf_counter() - start
             self.totals[name] += elapsed
             self.counts[name] += 1

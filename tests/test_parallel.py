@@ -199,13 +199,32 @@ class TestParameterAveraging:
         mesh = TpuEnvironment().make_mesh()
         pa = ParameterAveragingTrainer(graph, mesh, batch_size_per_worker=4, averaging_frequency=2)
         # 168 rows: two full rounds of 8*2*4=64, then a tail round of freq 1
-        # (32 rows), then 8 rows dropped (< one minibatch per worker)
+        # (32 rows), then a ragged-tail round for the last 8 rows (1/worker) —
+        # every example trains, nothing is dropped
         x, y = toy_data(8 * 2 * 4 * 2 + 40)
         it = ArrayDataSetIterator(x, y, batch_size=32)
         state, losses = pa.fit(pa.init_state(), it)
-        assert len(losses) == 2 + 2 + 1
-        assert int(state.step) == 5
+        assert len(losses) == 2 + 2 + 1 + 1
+        assert int(state.step) == 6
         assert np.isfinite(losses).all()
+
+    def test_small_fit_still_trains(self):
+        # fewer rows than workers*batch must still produce an update (the
+        # GanExperiment per-iteration fits are exactly this shape)
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        pa = ParameterAveragingTrainer(graph, mesh, batch_size_per_worker=200, averaging_frequency=10)
+        x, y = toy_data(24)
+        it = ArrayDataSetIterator(x, y, batch_size=24)
+        state0 = pa.init_state()
+        p0 = jax.tree_util.tree_map(np.asarray, state0.params)
+        state, losses = pa.fit(state0, it)
+        assert len(losses) == 1 and np.isfinite(losses).all()
+        assert int(state.step) == 1
+        diffs = jax.tree_util.tree_map(
+            lambda a, b_: float(np.max(np.abs(np.asarray(a) - b_))), state.params, p0
+        )
+        assert max(jax.tree_util.tree_leaves(diffs)) > 0
 
     def test_bad_round_size_raises(self):
         graph = small_classifier()
