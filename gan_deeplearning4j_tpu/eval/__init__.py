@@ -1,0 +1,29 @@
+"""Evaluation layer (SURVEY §7.8): the gan.ipynb cell-6 analogs — accuracy on
+exported predictions, latent-manifold image rendering — plus the FID harness
+BASELINE.md requires (the reference never records a quantitative metric)."""
+
+from gan_deeplearning4j_tpu.eval.accuracy import (
+    accuracy_from_csvs,
+    accuracy_score,
+    evaluate_classifier,
+)
+from gan_deeplearning4j_tpu.eval.fid import (
+    FeatureStats,
+    fid_from_stats,
+    fid_score,
+    graph_feature_fn,
+)
+from gan_deeplearning4j_tpu.eval.images import render_manifold, tile_images, write_png
+
+__all__ = [
+    "accuracy_from_csvs",
+    "accuracy_score",
+    "evaluate_classifier",
+    "FeatureStats",
+    "fid_from_stats",
+    "fid_score",
+    "graph_feature_fn",
+    "render_manifold",
+    "tile_images",
+    "write_png",
+]

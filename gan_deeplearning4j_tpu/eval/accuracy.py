@@ -1,0 +1,54 @@
+"""Classifier accuracy — the gan.ipynb cell-6 analog.
+
+The reference's acceptance test is offline: pandas reads
+``mnist_test_predictions_1.csv``, takes argmax per row, and compares against
+the test labels (``idxmax(axis=1) == y_test``). Same contract here, plus an
+in-process path that runs the classifier directly."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def accuracy_score(pred_probs: np.ndarray, labels: np.ndarray) -> float:
+    """mean(argmax(probs) == y) — cell 6's accuracy line. ``labels`` may be
+    integer class ids or one-hot rows."""
+    pred_probs = np.asarray(pred_probs)
+    labels = np.asarray(labels)
+    if labels.ndim > 1:
+        labels = labels.argmax(axis=1)
+    if pred_probs.shape[0] != labels.shape[0]:
+        raise ValueError(
+            f"{pred_probs.shape[0]} predictions vs {labels.shape[0]} labels"
+        )
+    return float(np.mean(pred_probs.argmax(axis=1) == labels))
+
+
+def accuracy_from_csvs(predictions_csv: str, test_csv: str, num_features: int = 784) -> float:
+    """The exact offline flow: predictions CSV (N×classes probabilities, as
+    written by GanExperiment.export_predictions) against the reference-format
+    test CSV whose last column is the integer label."""
+    preds = np.loadtxt(predictions_csv, delimiter=",", ndmin=2)
+    test = np.loadtxt(test_csv, delimiter=",", ndmin=2)
+    labels = test[:, num_features].astype(np.int64)
+    return accuracy_score(preds, labels)
+
+
+def evaluate_classifier(
+    graph, params, features: np.ndarray, labels: np.ndarray, batch_size: int = 500
+) -> float:
+    """In-process accuracy: batched inference (the reference's 500-row
+    prediction batches, dl4jGANComputerVision.java:67,576-598) → argmax."""
+    import jax
+    import jax.numpy as jnp
+
+    fwd = jax.jit(lambda p, x: graph.output(p, x, train=False))
+    chunks = []
+    for i in range(0, len(features), batch_size):
+        chunks.append(np.asarray(fwd(params, jnp.asarray(features[i : i + batch_size]))))
+    preds: Optional[np.ndarray] = np.vstack(chunks) if chunks else None
+    if preds is None:
+        raise ValueError("no features to evaluate")
+    return accuracy_score(preds, labels)

@@ -1,0 +1,96 @@
+"""FID harness (BASELINE.md: "DCGAN images/sec/chip …; FID@50k").
+
+Fréchet Inception Distance fits Gaussians to feature activations of real vs
+generated samples and measures ||μr−μg||² + Tr(Σr+Σg−2(ΣrΣg)^½). The canonical
+feature net is InceptionV3 pool3; this environment has no network egress to
+fetch those weights, so the extractor is pluggable: ``graph_feature_fn`` taps
+any named layer of a framework graph (e.g. the trained discriminator's
+``dis_dense_layer_6`` — the same features the reference's transfer classifier
+trusts). FID values are therefore comparable *within* this harness across
+runs/models, which is exactly what BASELINE.md needs (the reference publishes
+no FID to match). Plug in an Inception extractor for literature-comparable
+numbers."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureStats:
+    """Gaussian moments of a feature set: mean (D,) and covariance (D, D)."""
+
+    mean: np.ndarray
+    cov: np.ndarray
+
+    @staticmethod
+    def from_features(features: np.ndarray) -> "FeatureStats":
+        features = np.asarray(features, dtype=np.float64)
+        if features.ndim != 2:
+            features = features.reshape(features.shape[0], -1)
+        if features.shape[0] < 2:
+            raise ValueError("need at least 2 samples to fit covariance")
+        return FeatureStats(
+            mean=features.mean(axis=0),
+            cov=np.cov(features, rowvar=False).reshape(
+                features.shape[1], features.shape[1]
+            ),
+        )
+
+
+def _sqrtm_psd(mat: np.ndarray) -> np.ndarray:
+    """Matrix square root of a (near-)PSD symmetric matrix via eigendecomp —
+    numerically safer than scipy.linalg.sqrtm for GAN feature covariances."""
+    vals, vecs = np.linalg.eigh((mat + mat.T) / 2.0)
+    vals = np.clip(vals, 0.0, None)
+    return (vecs * np.sqrt(vals)) @ vecs.T
+
+
+def fid_from_stats(real: FeatureStats, fake: FeatureStats, eps: float = 1e-6) -> float:
+    """Fréchet distance between the two Gaussians."""
+    diff = real.mean - fake.mean
+    # regularize before the product: keeps the sqrt stable when either
+    # covariance is rank-deficient (small sample counts). Using
+    # sqrt(A)·B·sqrt(A) keeps the argument symmetric PSD; its sqrt has the
+    # same trace as sqrtm(A·B) in the textbook formula.
+    offset = eps * np.eye(real.cov.shape[0])
+    sr = _sqrtm_psd(real.cov + offset)
+    covmean = _sqrtm_psd(sr @ (fake.cov + offset) @ sr)
+    return float(diff @ diff + np.trace(real.cov + fake.cov - 2.0 * covmean))
+
+
+def graph_feature_fn(graph, params, layer_name: str, batch_size: int = 500) -> Callable:
+    """Feature extractor tapping ``layer_name``'s activation of a framework
+    graph (ComputationGraph.feed_forward), batched on device."""
+    import jax
+    import jax.numpy as jnp
+
+    tap = jax.jit(
+        lambda p, x: graph.feed_forward(p, x, train=False)[layer_name]
+    )
+
+    def extract(samples: np.ndarray) -> np.ndarray:
+        chunks = []
+        for i in range(0, len(samples), batch_size):
+            out = np.asarray(tap(params, jnp.asarray(samples[i : i + batch_size])))
+            chunks.append(out.reshape(out.shape[0], -1))
+        return np.concatenate(chunks, axis=0)
+
+    return extract
+
+
+def fid_score(
+    real_samples: np.ndarray,
+    fake_samples: np.ndarray,
+    feature_fn: Optional[Callable] = None,
+) -> float:
+    """End-to-end FID: extract features (identity when ``feature_fn`` is None
+    — raw-pixel FID, useful for smoke tests), fit stats, measure."""
+    extract = feature_fn if feature_fn is not None else (lambda x: np.asarray(x).reshape(len(x), -1))
+    return fid_from_stats(
+        FeatureStats.from_features(extract(real_samples)),
+        FeatureStats.from_features(extract(fake_samples)),
+    )

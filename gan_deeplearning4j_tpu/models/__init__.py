@@ -1,6 +1,6 @@
 """Model zoo: the reference DCGAN-MNIST family plus the BASELINE.md configs
-(tabular MLP-GAN, CIFAR-10 DCGAN, CelebA-64 DCGAN, WGAN-GP critic)."""
+(tabular MLP-GAN, CIFAR-10/CelebA-64 image DCGANs, WGAN-GP)."""
 
-from gan_deeplearning4j_tpu.models import dcgan_mnist
+from gan_deeplearning4j_tpu.models import dcgan_image, dcgan_mnist, mlp_gan, wgan_gp
 
-__all__ = ["dcgan_mnist"]
+__all__ = ["dcgan_image", "dcgan_mnist", "mlp_gan", "wgan_gp"]

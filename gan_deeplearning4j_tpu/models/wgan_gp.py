@@ -1,0 +1,272 @@
+"""WGAN-GP — BASELINE.md config 5: Wasserstein GAN with gradient penalty on
+CIFAR-10-shaped data, the grad-of-grad config ("lowered through XLA").
+
+Differences from the XENT families, per Gulrajani et al. 2017:
+- the critic ends in a LINEAR score (no sigmoid), loss = E[D(fake)] − E[D(real)];
+- no BatchNorm in the critic (GP is defined per-example; batch statistics
+  couple examples), so the critic is conv/dense only;
+- critic trains ``n_critic`` steps per generator step;
+- the penalty λ·E[(‖∇_x̂ D(x̂)‖−1)²] differentiates *through* the critic's
+  input gradient — ``jax.grad`` composed over ``jax.grad``, which XLA lowers
+  natively (ops/losses.py::gradient_penalty).
+
+The trainer fuses each critic round (n_critic steps, lax.scan) and the
+generator step into single jitted programs, donated, mesh-shardable over the
+``data`` axis — the same execution shape as the fused DCGAN iteration."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.nn import (
+    BatchNormalization,
+    ComputationGraph,
+    ConvolutionLayer,
+    Deconvolution2D,
+    DenseLayer,
+    FeedForwardToCnnPreProcessor,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.optim import RmsProp
+from gan_deeplearning4j_tpu.optim.optimizer import GraphOptimizer
+from gan_deeplearning4j_tpu.ops import losses as loss_ops
+from gan_deeplearning4j_tpu.parallel.trainer import TrainState, make_train_state
+
+
+@dataclasses.dataclass(frozen=True)
+class WganGpConfig:
+    height: int = 32
+    width: int = 32
+    channels: int = 3
+    z_size: int = 128
+    base_filters: int = 64
+    dense_width: int = 1024
+    critic_learning_rate: float = 2e-4
+    gen_learning_rate: float = 2e-4
+    gp_lambda: float = 10.0
+    n_critic: int = 5
+    seed: int = 666
+    grad_clip: float = 0.0  # WGAN-GP needs no clipping; GP regularizes
+
+    @property
+    def num_features(self) -> int:
+        return self.height * self.width * self.channels
+
+    @property
+    def stages(self) -> int:
+        from gan_deeplearning4j_tpu.models.dcgan_image import stages_for
+
+        return stages_for(self.height, self.width)
+
+
+def _graph_config(cfg: WganGpConfig, lr: float) -> GraphConfig:
+    return GraphConfig(
+        seed=cfg.seed,
+        default_activation="leaky_relu",
+        weight_init="xavier",
+        l2=0.0,
+        gradient_clip=None if cfg.grad_clip <= 0 else "elementwise",
+        gradient_clip_value=cfg.grad_clip,
+        updater=RmsProp(lr, 0.9, 1e-8),
+        optimization_algo="sgd",
+    )
+
+
+def build_critic(cfg: WganGpConfig = WganGpConfig()) -> ComputationGraph:
+    """Conv critic, NO BatchNorm, linear score head (loss='wasserstein')."""
+    up = RmsProp(cfg.critic_learning_rate, 0.9, 1e-8)
+    b = GraphBuilder(_graph_config(cfg, cfg.critic_learning_rate))
+    b.add_inputs("critic_input_0")
+    b.set_input_types(InputType.convolutional_flat(cfg.height, cfg.width, cfg.channels))
+    prev = "critic_input_0"
+    n_in, filters = cfg.channels, cfg.base_filters
+    for i in range(cfg.stages):
+        name = f"critic_conv2d_{i + 1}"
+        b.add_layer(
+            name,
+            ConvolutionLayer(kernel=5, stride=2, padding=2, n_in=n_in, n_out=filters, updater=up),
+            prev,
+        )
+        prev = name
+        n_in, filters = filters, filters * 2
+    b.add_layer("critic_dense", DenseLayer(n_out=cfg.dense_width, updater=up), prev)
+    b.add_layer(
+        "critic_score",
+        OutputLayer(n_out=1, activation="identity", loss="wasserstein", updater=up),
+        "critic_dense",
+    )
+    b.set_outputs("critic_score")
+    return b.build()
+
+
+def build_generator(cfg: WganGpConfig = WganGpConfig()) -> ComputationGraph:
+    """z → dense stem → deconv ×2 stages → sigmoid image, BN allowed here."""
+    up = RmsProp(cfg.gen_learning_rate, 0.9, 1e-8)
+    stem_c = cfg.base_filters * (2 ** (cfg.stages - 1))
+    b = GraphBuilder(_graph_config(cfg, cfg.gen_learning_rate))
+    b.add_inputs("gen_input_0")
+    b.set_input_types(InputType.feed_forward(cfg.z_size))
+    b.add_layer("gen_dense_1", DenseLayer(n_out=4 * 4 * stem_c, updater=up), "gen_input_0")
+    b.add_layer("gen_batch_2", BatchNormalization(updater=up), "gen_dense_1")
+    prev = "gen_batch_2"
+    pre = FeedForwardToCnnPreProcessor(4, 4, stem_c)
+    c = stem_c
+    for s in range(cfg.stages):
+        n_out = max(cfg.base_filters // 2, c // 2)
+        name = f"gen_deconv2d_{3 + s}"
+        b.add_layer(
+            name,
+            Deconvolution2D(kernel=4, stride=2, padding=1, n_in=c, n_out=n_out, updater=up),
+            prev,
+            preprocessor=pre if s == 0 else None,
+        )
+        prev = name
+        c = n_out
+    b.add_layer(
+        "gen_image",
+        ConvolutionLayer(kernel=5, stride=1, padding=2, n_in=c, n_out=cfg.channels,
+                         activation="sigmoid", updater=up),
+        prev,
+    )
+    b.set_outputs("gen_image")
+    return b.build()
+
+
+class WganGpTrainer:
+    """Alternating WGAN-GP training: one fused critic round (n_critic scanned
+    steps) + one fused generator step, both jitted with donation."""
+
+    def __init__(
+        self,
+        cfg: WganGpConfig = WganGpConfig(),
+        mesh: Optional[jax.sharding.Mesh] = None,
+        data_axis: str = "data",
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.critic = build_critic(cfg)
+        self.generator = build_generator(cfg)
+        self.critic_opt = GraphOptimizer(self.critic)
+        self.gen_opt = GraphOptimizer(self.generator)
+        self._critic_round = self._build_critic_round()
+        self._gen_step = self._build_gen_step()
+
+    # -- state --------------------------------------------------------------
+    def init_states(self, seed: Optional[int] = None) -> Tuple[TrainState, TrainState]:
+        critic = make_train_state(self.critic, self.critic_opt, self.mesh, seed)
+        gen = make_train_state(self.generator, self.gen_opt, self.mesh, seed)
+        return critic, gen
+
+    def _shardings(self):
+        if self.mesh is None:
+            return {}
+        rep = jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
+        data = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(self.data_axis)
+        )
+        return {"rep": rep, "data": data}
+
+    def _critic_loss(self, cparams, gen_params, real, rng):
+        """E[D(fake)] − E[D(real)] + λ·GP. Flat (N, F) in/out — the critic
+        graph's flat→cnn adapter reshapes internally, so the GP's input
+        gradient is taken w.r.t. the flat pixels (norm is reshape-invariant)."""
+        b = real.shape[0]
+        k_z, k_gp = jax.random.split(rng)
+        z = jax.random.normal(k_z, (b, self.cfg.z_size), real.dtype)
+        fake = self.generator.output(gen_params, z, train=False)
+        fake = fake.reshape(b, -1)
+
+        def score(x):
+            return self.critic.output(cparams, x, train=False)[:, 0]
+
+        w_loss = jnp.mean(score(fake)) - jnp.mean(score(real))
+        gp = loss_ops.gradient_penalty(score, real, fake, k_gp)
+        return w_loss + self.cfg.gp_lambda * gp
+
+    def _build_critic_round(self):
+        def round_fn(critic_state: TrainState, gen_params, real_batches, rng):
+            """real_batches: (n_critic, B, F) — one critic step per slice."""
+
+            def body(carry, inputs):
+                params, opt_state, key = carry
+                real = inputs
+                key, sub = jax.random.split(key)
+                loss, grads = jax.value_and_grad(self._critic_loss)(
+                    params, gen_params, real, sub
+                )
+                params, opt_state = self.critic_opt.step(params, grads, opt_state)
+                return (params, opt_state, key), loss
+
+            (params, opt_state, _), losses = jax.lax.scan(
+                body, (critic_state.params, critic_state.opt_state, rng), real_batches
+            )
+            new_state = TrainState(
+                params, opt_state, critic_state.step + real_batches.shape[0]
+            )
+            return new_state, jnp.mean(losses)
+
+        kwargs = {"donate_argnums": (0,)}
+        sh = self._shardings()
+        if sh:
+            # scan axis replicated, batch axis sharded
+            batches = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(None, self.data_axis)
+            )
+            kwargs["in_shardings"] = (sh["rep"], sh["rep"], batches, sh["rep"])
+            kwargs["out_shardings"] = (sh["rep"], sh["rep"])
+        return jax.jit(round_fn, **kwargs)
+
+    def _build_gen_step(self):
+        def gen_loss(gparams, cparams, z):
+            outs, new_params = self.generator.apply(gparams, z, train=True)
+            fake = outs["gen_image"].reshape(z.shape[0], -1)
+            loss = -jnp.mean(self.critic.output(cparams, fake, train=False)[:, 0])
+            return loss, new_params  # new_params carries BN running stats
+
+        def step(gen_state: TrainState, critic_params, z):
+            (loss, new_params), grads = jax.value_and_grad(gen_loss, has_aux=True)(
+                gen_state.params, critic_params, z
+            )
+            params, opt_state = self.gen_opt.step(new_params, grads, gen_state.opt_state)
+            return TrainState(params, opt_state, gen_state.step + 1), loss
+
+        kwargs = {"donate_argnums": (0,)}
+        sh = self._shardings()
+        if sh:
+            kwargs["in_shardings"] = (sh["rep"], sh["rep"], sh["data"])
+            kwargs["out_shardings"] = (sh["rep"], sh["rep"])
+        return jax.jit(step, **kwargs)
+
+    # -- public steps -------------------------------------------------------
+    def train_round(
+        self, critic_state: TrainState, gen_state: TrainState, real_batches, rng
+    ):
+        """One WGAN-GP round: n_critic critic steps then one generator step.
+        ``real_batches`` is (n_critic, B, num_features) float32 in [0,1]."""
+        real_batches = jnp.asarray(real_batches)
+        if real_batches.shape[0] != self.cfg.n_critic:
+            raise ValueError(
+                f"need {self.cfg.n_critic} critic batches, got {real_batches.shape[0]}"
+            )
+        k_c, k_g = jax.random.split(jnp.asarray(rng))
+        critic_state, c_loss = self._critic_round(
+            critic_state, gen_state.params, real_batches, k_c
+        )
+        z = jax.random.normal(
+            k_g, (real_batches.shape[1], self.cfg.z_size), real_batches.dtype
+        )
+        gen_state, g_loss = self._gen_step(gen_state, critic_state.params, z)
+        return critic_state, gen_state, c_loss, g_loss
+
+    def sample(self, gen_state: TrainState, rng, num: int):
+        """Generate ``num`` images (num, H, W, C) for eval/FID."""
+        z = jax.random.normal(jnp.asarray(rng), (num, self.cfg.z_size), jnp.float32)
+        return self.generator.output(gen_state.params, z, train=False)

@@ -368,6 +368,12 @@ class ComputationGraph:
     ):
         """Feed-forward. Returns (outputs, new_params) where new_params carries
         BN running-stat updates when train=True (identical tree otherwise)."""
+        acts, new_params = self._traverse(params, inputs, train=train, rng=rng)
+        outputs = {o: acts[o] for o in self.output_names}
+        return outputs, new_params
+
+    def _traverse(self, params: Dict, inputs, *, train: bool, rng=None):
+        """Shared forward traversal: returns (all activations, new_params)."""
         if not isinstance(inputs, dict):
             if len(self.input_names) != 1:
                 raise ValueError("graph has multiple inputs; pass a dict")
@@ -390,8 +396,7 @@ class ComputationGraph:
             if updates:
                 new_params[v.name] = {**params[v.name], **updates}
             acts[v.name] = y
-        outputs = {o: acts[o] for o in self.output_names}
-        return outputs, new_params
+        return acts, new_params
 
     def output(self, params: Dict, inputs, *, train: bool = False):
         """Inference convenience (DL4J ``graph.output(x)``): returns the single
@@ -400,6 +405,13 @@ class ComputationGraph:
         if len(self.output_names) == 1:
             return outs[self.output_names[0]]
         return outs
+
+    def feed_forward(self, params: Dict, inputs, *, train: bool = False, rng=None):
+        """Per-vertex activation map (DL4J ``ComputationGraph.feedForward``):
+        {vertex name: activation}, inputs included. Used for feature
+        extraction (e.g. FID on discriminator features) and debugging."""
+        acts, _ = self._traverse(params, inputs, train=train, rng=rng)
+        return acts
 
     # -- loss ---------------------------------------------------------------
     def l2_penalty(self, params: Dict) -> jnp.ndarray:

@@ -1,0 +1,140 @@
+"""Eval layer tests: accuracy (cell-6 analog), manifold PNG, FID harness."""
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.eval import (
+    FeatureStats,
+    accuracy_from_csvs,
+    accuracy_score,
+    evaluate_classifier,
+    fid_from_stats,
+    fid_score,
+    graph_feature_fn,
+    render_manifold,
+    tile_images,
+    write_png,
+)
+
+
+class TestAccuracy:
+    def test_known_values(self):
+        probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4], [0.3, 0.7]])
+        assert accuracy_score(probs, np.array([0, 1, 1, 1])) == 0.75
+        one_hot = np.eye(2)[[0, 1, 1, 1]]
+        assert accuracy_score(probs, one_hot) == 0.75
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            accuracy_score(np.zeros((3, 2)), np.zeros(4))
+
+    def test_csv_flow(self, tmp_path):
+        # 4 features per row + label column, 3 rows; predictions argmax
+        # matches labels on 2 of 3
+        test_csv = tmp_path / "t.csv"
+        rows = np.hstack([np.random.rand(3, 4), np.array([[0.0], [1.0], [2.0]])])
+        np.savetxt(test_csv, rows, delimiter=",")
+        preds = np.array([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.7, 0.2, 0.1]])
+        pred_csv = tmp_path / "p.csv"
+        np.savetxt(pred_csv, preds, delimiter=",")
+        acc = accuracy_from_csvs(str(pred_csv), str(test_csv), num_features=4)
+        assert abs(acc - 2.0 / 3.0) < 1e-9
+
+    def test_evaluate_classifier_on_graph(self):
+        from gan_deeplearning4j_tpu.nn import (
+            DenseLayer,
+            GraphBuilder,
+            GraphConfig,
+            InputType,
+            OutputLayer,
+        )
+
+        b = GraphBuilder(GraphConfig(seed=0))
+        b.add_inputs("in")
+        b.set_input_types(InputType.feed_forward(8))
+        b.add_layer("h", DenseLayer(n_out=16), "in")
+        b.add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "h")
+        b.set_outputs("out")
+        g = b.build()
+        params = g.init()
+        x = np.random.default_rng(0).random((10, 8), dtype=np.float32)
+        y = np.random.default_rng(1).integers(0, 3, 10)
+        acc = evaluate_classifier(g, params, x, y, batch_size=4)
+        assert 0.0 <= acc <= 1.0
+
+
+class TestImages:
+    def test_tile_layout(self):
+        imgs = np.arange(4 * 2 * 2, dtype=np.float32).reshape(4, 2, 2)
+        mosaic = tile_images(imgs, 2)
+        assert mosaic.shape == (4, 4)
+        # row-major placement: image 1 occupies top-right block
+        np.testing.assert_array_equal(mosaic[0:2, 2:4], imgs[1])
+
+    def test_tile_wrong_count(self):
+        with pytest.raises(ValueError):
+            tile_images(np.zeros((3, 2, 2)), 2)
+
+    def test_png_signature_and_roundtrip_sizes(self, tmp_path):
+        path = str(tmp_path / "g.png")
+        write_png(path, np.random.rand(7, 5))
+        data = open(path, "rb").read()
+        assert data[:8] == b"\x89PNG\r\n\x1a\n"
+        assert b"IHDR" in data and b"IDAT" in data and b"IEND" in data
+        # RGB path
+        write_png(str(tmp_path / "c.png"), np.random.rand(4, 4, 3))
+        # bad shape
+        with pytest.raises(ValueError):
+            write_png(str(tmp_path / "bad.png"), np.zeros((2, 2, 4)))
+
+    def test_render_manifold_from_csv(self, tmp_path):
+        flat = np.random.rand(100, 784)
+        csv = tmp_path / "mnist_out_1.csv"
+        np.savetxt(csv, flat, delimiter=",")
+        out = render_manifold(str(csv), str(tmp_path / "m.png"), grid=10, side=28)
+        assert open(out, "rb").read()[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+class TestFid:
+    def test_identical_distributions_near_zero(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(500, 8))
+        fid = fid_from_stats(
+            FeatureStats.from_features(x), FeatureStats.from_features(x)
+        )
+        # not exactly 0: the eps regularizer leaves a ~1e-5 residual
+        assert abs(fid) < 1e-3
+
+    def test_mean_shift_matches_closed_form(self):
+        # same covariance, shifted mean: FID ≈ ||Δμ||²
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(20000, 4))
+        shift = np.array([1.0, -2.0, 0.5, 0.0])
+        fid = fid_score(x, x + shift)
+        assert abs(fid - float(shift @ shift)) < 0.05 * float(shift @ shift) + 0.05
+
+    def test_orders_models(self):
+        # a wildly off distribution must score worse than a close one
+        rng = np.random.default_rng(2)
+        real = rng.normal(size=(1000, 6))
+        close = real + 0.1 * rng.normal(size=real.shape)
+        far = 5.0 + 3.0 * rng.normal(size=real.shape)
+        assert fid_score(real, close) < fid_score(real, far)
+
+    def test_too_few_samples_raises(self):
+        with pytest.raises(ValueError):
+            FeatureStats.from_features(np.zeros((1, 3)))
+
+    def test_graph_feature_fn_on_discriminator(self):
+        from gan_deeplearning4j_tpu.models import dcgan_mnist
+
+        dis = dcgan_mnist.build_discriminator()
+        params = dis.init()
+        extract = graph_feature_fn(dis, params, "dis_dense_layer_6", batch_size=8)
+        feats = extract(np.random.default_rng(0).random((12, 784), dtype=np.float32))
+        assert feats.shape == (12, 1024)
+        rng = np.random.default_rng(3)
+        real = rng.random((32, 784), dtype=np.float32)
+        fake = rng.random((32, 784), dtype=np.float32)
+        fid = fid_score(real, fake, feature_fn=extract)
+        assert np.isfinite(fid) and fid >= 0.0

@@ -1,0 +1,198 @@
+"""Model-family tests: tabular MLP-GAN, CIFAR-10/CelebA image DCGANs, WGAN-GP.
+
+Mirrors the reference's smoke-check style (shape assertions after init +
+forward, SURVEY §4.1) plus training-moves-the-loss checks and weight-sync
+round trips for each family."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.models import dcgan_image, mlp_gan, wgan_gp
+from gan_deeplearning4j_tpu.nn import ComputationGraph
+from gan_deeplearning4j_tpu.parallel import GraphTrainer
+from gan_deeplearning4j_tpu.runtime import TpuEnvironment
+
+
+class TestMlpGan:
+    def test_shapes(self):
+        cfg = mlp_gan.MlpGanConfig(num_features=16, z_size=4, hidden=(32, 32))
+        dis, gen, gan = (
+            mlp_gan.build_discriminator(cfg),
+            mlp_gan.build_generator(cfg),
+            mlp_gan.build_gan(cfg),
+        )
+        x = jnp.ones((6, 16))
+        z = jnp.ones((6, 4))
+        assert dis.output(dis.init(), x).shape == (6, 1)
+        assert gen.output(gen.init(), z).shape == (6, 16)
+        assert gan.output(gan.init(), z).shape == (6, 1)
+
+    def test_sync_maps_cover_all_param_layers(self):
+        cfg = mlp_gan.MlpGanConfig(num_features=16, z_size=4, hidden=(32, 32))
+        dis, gen, gan = (
+            mlp_gan.build_discriminator(cfg),
+            mlp_gan.build_generator(cfg),
+            mlp_gan.build_gan(cfg),
+        )
+        dis_to_gan, gan_to_gen = mlp_gan.sync_maps(cfg)
+        dis_params, gen_params, gan_params = dis.init(), gen.init(), gan.init()
+        # every map entry resolves and copies without shape errors
+        merged = ComputationGraph.copy_params(dis_params, gan_params, dis_to_gan)
+        merged2 = ComputationGraph.copy_params(merged, gen_params, gan_to_gen)
+        for src, dst in dis_to_gan.items():
+            for p, v in dis_params[src].items():
+                np.testing.assert_array_equal(np.asarray(merged[dst][p]), np.asarray(v))
+        # gen got gan's generator-side weights
+        for src, dst in gan_to_gen.items():
+            for p in merged[src]:
+                np.testing.assert_array_equal(
+                    np.asarray(merged2[dst][p]), np.asarray(merged[src][p])
+                )
+
+    def test_training_reduces_loss(self):
+        cfg = mlp_gan.MlpGanConfig(num_features=13, z_size=4, hidden=(32,))
+        dis = mlp_gan.build_discriminator(cfg)
+        trainer = GraphTrainer(dis)
+        state = trainer.init_state()
+        data = mlp_gan.synthetic_transactions(64, num_features=13, seed=1)
+        labels = np.ones((64, 1), np.float32)  # teach D "this is real"
+        first = last = None
+        for _ in range(12):
+            state, loss = trainer.train_step(state, jnp.asarray(data), jnp.asarray(labels))
+            first = float(loss) if first is None else first
+            last = float(loss)
+        assert last < first
+
+    def test_synthetic_transactions_contract(self):
+        t = mlp_gan.synthetic_transactions(100, num_features=32, seed=2)
+        assert t.shape == (100, 32) and t.dtype == np.float32
+        assert t.min() >= 0.0 and t.max() <= 1.0
+        # deterministic per seed
+        np.testing.assert_array_equal(t, mlp_gan.synthetic_transactions(100, 32, seed=2))
+        # structured: features correlate (not white noise)
+        corr = np.corrcoef(t.T)
+        off = np.abs(corr[np.triu_indices(32, k=1)])
+        assert off.max() > 0.3
+
+
+class TestImageDcgan:
+    @pytest.mark.parametrize("cfg", [dcgan_image.CIFAR10, dcgan_image.CELEBA64])
+    def test_shapes(self, cfg):
+        small = dcgan_image.ImageGanConfig(
+            height=cfg.height, width=cfg.width, channels=cfg.channels,
+            z_size=8, base_filters=8, dense_width=32,
+        )
+        dis, gen, gan = (
+            dcgan_image.build_discriminator(small),
+            dcgan_image.build_generator(small),
+            dcgan_image.build_gan(small),
+        )
+        n = 2
+        x = jnp.ones((n, small.num_features))
+        z = jnp.ones((n, small.z_size))
+        assert dis.output(dis.init(), x).shape == (n, 1)
+        img = gen.output(gen.init(), z)
+        assert img.shape == (n, cfg.height, cfg.width, cfg.channels)
+        assert gan.output(gan.init(), z).shape == (n, 1)
+
+    def test_sync_maps_resolve(self):
+        small = dcgan_image.ImageGanConfig(z_size=8, base_filters=8, dense_width=32)
+        dis, gen, gan = (
+            dcgan_image.build_discriminator(small),
+            dcgan_image.build_generator(small),
+            dcgan_image.build_gan(small),
+        )
+        dis_to_gan, gan_to_gen = dcgan_image.sync_maps(small)
+        merged = ComputationGraph.copy_params(dis.init(), gan.init(), dis_to_gan)
+        ComputationGraph.copy_params(merged, gen.init(), gan_to_gen)
+        # maps cover every parameterized dis layer
+        dis_param_layers = {n for n, p in dis.init().items() if p}
+        assert dis_param_layers == set(dis_to_gan)
+
+    def test_bad_side_raises(self):
+        with pytest.raises(ValueError):
+            dcgan_image.ImageGanConfig(height=28, width=28).stages
+
+    def test_synthetic_images_contract(self):
+        small = dcgan_image.ImageGanConfig(z_size=8, base_filters=8, dense_width=32)
+        imgs = dcgan_image.synthetic_images(5, small, seed=3)
+        assert imgs.shape == (5, small.num_features)
+        assert imgs.min() >= 0.0 and imgs.max() <= 1.0
+
+
+class TestWganGp:
+    def _small(self):
+        return wgan_gp.WganGpConfig(
+            height=8, width=8, channels=1, z_size=4, base_filters=4,
+            dense_width=16, n_critic=2,
+        )
+
+    def test_shapes_and_round(self):
+        cfg = self._small()
+        tr = wgan_gp.WganGpTrainer(cfg)
+        critic_state, gen_state = tr.init_states(seed=0)
+        b = 6
+        real = np.random.default_rng(0).random(
+            (cfg.n_critic, b, cfg.num_features), np.float32
+        )
+        critic_state, gen_state, c_loss, g_loss = tr.train_round(
+            critic_state, gen_state, real, jax.random.PRNGKey(1)
+        )
+        assert np.isfinite(float(c_loss)) and np.isfinite(float(g_loss))
+        assert int(critic_state.step) == cfg.n_critic
+        assert int(gen_state.step) == 1
+        imgs = tr.sample(gen_state, jax.random.PRNGKey(2), 3)
+        assert imgs.shape == (3, 8, 8, 1)
+        assert float(jnp.min(imgs)) >= 0.0 and float(jnp.max(imgs)) <= 1.0
+
+    def test_gradient_penalty_pulls_norm_to_one(self):
+        # after several critic rounds on fixed data, the critic's input-grad
+        # norm at interpolates should move toward 1 (the GP target)
+        cfg = self._small()
+        tr = wgan_gp.WganGpTrainer(cfg)
+        critic_state, gen_state = tr.init_states(seed=0)
+        rng = np.random.default_rng(1)
+        real = rng.random((cfg.n_critic, 8, cfg.num_features), np.float32)
+
+        def grad_norm(params):
+            x = jnp.asarray(real[0])
+
+            def s(x):
+                return jnp.sum(tr.critic.output(params, x, train=False))
+
+            g = jax.grad(s)(x)
+            return float(jnp.mean(jnp.sqrt(jnp.sum(g**2, axis=1))))
+
+        before = abs(grad_norm(critic_state.params) - 1.0)
+        key = jax.random.PRNGKey(0)
+        for i in range(10):
+            key, sub = jax.random.split(key)
+            critic_state, gen_state, _, _ = tr.train_round(
+                critic_state, gen_state, real, sub
+            )
+        after = abs(grad_norm(critic_state.params) - 1.0)
+        assert after < before
+
+    def test_critic_round_count_validation(self):
+        cfg = self._small()
+        tr = wgan_gp.WganGpTrainer(cfg)
+        cs, gs = tr.init_states()
+        bad = np.zeros((cfg.n_critic + 1, 4, cfg.num_features), np.float32)
+        with pytest.raises(ValueError):
+            tr.train_round(cs, gs, bad, jax.random.PRNGKey(0))
+
+    def test_data_parallel_round(self):
+        cfg = self._small()
+        mesh = TpuEnvironment().make_mesh()
+        tr = wgan_gp.WganGpTrainer(cfg, mesh=mesh)
+        critic_state, gen_state = tr.init_states(seed=0)
+        b = 16  # divisible by the 8-device fake mesh
+        real = np.random.default_rng(0).random(
+            (cfg.n_critic, b, cfg.num_features), np.float32
+        )
+        critic_state, gen_state, c_loss, g_loss = tr.train_round(
+            critic_state, gen_state, real, jax.random.PRNGKey(1)
+        )
+        assert np.isfinite(float(c_loss)) and np.isfinite(float(g_loss))
