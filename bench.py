@@ -1,75 +1,179 @@
-"""Headline benchmark: DCGAN-MNIST alternating-loop throughput (images/sec/chip).
+"""Benchmark harness for the five BASELINE.md configs.
 
-Runs the reference topology (dl4jGANComputerVision.java:117-314) at batch 64
-(BASELINE.json config 1) through the full alternating iteration — dis fit,
-weight sync, gan fit, sync, classifier fit — on whatever device jax provides,
-and prints ONE JSON line. The reference publishes no numbers (BASELINE.md), so
-this run *establishes* the baseline; vs_baseline is reported against the
-recorded target in this file once one exists.
-"""
+Default (what the driver runs): config 1 — DCGAN-MNIST alternating-loop
+throughput at batch 64 (the reference topology,
+dl4jGANComputerVision.java:117-314) — printed as ONE JSON line.
+
+``--config N|all`` runs the other configs (tabular MLP-GAN, CIFAR-10 DCGAN,
+CelebA-64 data-parallel, WGAN-GP); ``--json benchmarks.json`` also writes the
+full result list. The reference publishes no numbers (BASELINE.md), so these
+runs *establish* the baseline; vs_baseline reports against the recorded
+targets below once they exist."""
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
 import numpy as np
 
-# First recorded real-TPU number for this config becomes the baseline to beat.
-# None until a driver run on real hardware records one.
-BASELINE_IMAGES_PER_SEC = None
+# First recorded real-TPU numbers per config become the baselines to beat.
+BASELINES = {
+    "dcgan_mnist_images_per_sec_per_chip": None,
+    "tabular_mlp_gan_rows_per_sec_per_chip": None,
+    "dcgan_cifar10_images_per_sec_per_chip": None,
+    "dcgan_celeba64_dp_images_per_sec": None,
+    "wgan_gp_cifar10_images_per_sec_per_chip": None,
+}
 
 WARMUP_ITERS = 3
 TIMED_ITERS = 20
-BATCH = 64
 
 
-def main() -> None:
+def _bench_experiment(family: str, batch: int, *, height=28, width=28, channels=1,
+                      num_features=None, z_size=2, distributed="none", mesh=None):
+    """Throughput of the full alternating iteration for one GAN family."""
+    import jax
+
     from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
     from gan_deeplearning4j_tpu.harness.experiment import GanExperiment
 
+    num_features = num_features or height * width * channels
     cfg = ExperimentConfig(
-        batch_size_train=BATCH,
-        batch_size_pred=BATCH,
-        num_iterations=WARMUP_ITERS + TIMED_ITERS,
-        save_models=False,
+        model_family=family, batch_size_train=batch, batch_size_pred=batch,
+        height=height, width=width, channels=channels, num_features=num_features,
+        z_size=z_size, num_iterations=WARMUP_ITERS + TIMED_ITERS,
+        save_models=False, distributed=distributed,
     )
-    exp = GanExperiment(cfg)
-
+    exp = GanExperiment(cfg, mesh=mesh)
     rng = np.random.default_rng(0)
-    features = rng.random((BATCH, cfg.num_features), dtype=np.float32)
+    feats = exp.family.synthetic_data(batch, exp.model_cfg, 0)[:batch]
     labels = np.eye(cfg.num_classes, dtype=np.float32)[
-        rng.integers(0, cfg.num_classes, size=BATCH)
+        rng.integers(0, cfg.num_classes, size=batch)
     ]
-
-    import jax
-
     for _ in range(WARMUP_ITERS):
-        losses = exp.train_iteration(features, labels)
+        losses = exp.train_iteration(feats, labels)
     jax.block_until_ready(losses)
-
     t0 = time.perf_counter()
     for _ in range(TIMED_ITERS):
-        losses = exp.train_iteration(features, labels)
-    jax.block_until_ready(losses)  # iterations pipeline; settle before timing
-    elapsed = time.perf_counter() - t0
+        losses = exp.train_iteration(feats, labels)
+    jax.block_until_ready(losses)
+    return TIMED_ITERS * batch / (time.perf_counter() - t0)
 
-    images_per_sec = TIMED_ITERS * BATCH / elapsed
-    vs = (
-        images_per_sec / BASELINE_IMAGES_PER_SEC
-        if BASELINE_IMAGES_PER_SEC
-        else 1.0
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "dcgan_mnist_images_per_sec_per_chip",
-                "value": round(images_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+
+def bench_mnist():
+    return {
+        "metric": "dcgan_mnist_images_per_sec_per_chip",
+        "value": _bench_experiment("mnist", 64),
+        "unit": "images/sec",
+    }
+
+
+def bench_tabular():
+    return {
+        "metric": "tabular_mlp_gan_rows_per_sec_per_chip",
+        "value": _bench_experiment(
+            "tabular", 256, num_features=32, z_size=8, height=1, width=1, channels=1
+        ),
+        "unit": "rows/sec",
+    }
+
+
+def bench_cifar10():
+    return {
+        "metric": "dcgan_cifar10_images_per_sec_per_chip",
+        "value": _bench_experiment(
+            "cifar10", 64, height=32, width=32, channels=3, z_size=64
+        ),
+        "unit": "images/sec",
+    }
+
+
+def bench_celeba64():
+    """Data-parallel over all visible devices (v5e-8 in the target rig; on a
+    single chip this degenerates to a 1-device mesh — still the DP code path)."""
+    from gan_deeplearning4j_tpu.runtime import TpuEnvironment
+
+    mesh = TpuEnvironment().make_mesh()
+    n = mesh.devices.size
+    return {
+        "metric": "dcgan_celeba64_dp_images_per_sec",
+        "value": _bench_experiment(
+            "celeba64", 8 * n, height=64, width=64, channels=3, z_size=64,
+            distributed="pmean", mesh=mesh,
+        ),
+        "unit": "images/sec",
+        "devices": n,
+    }
+
+
+def bench_wgan_gp():
+    import jax
+
+    from gan_deeplearning4j_tpu.models import wgan_gp
+
+    cfg = wgan_gp.WganGpConfig()
+    tr = wgan_gp.WganGpTrainer(cfg)
+    critic_state, gen_state = tr.init_states(seed=0)
+    batch = 64
+    rng = np.random.default_rng(0)
+    real = rng.random((cfg.n_critic, batch, cfg.num_features), dtype=np.float32)
+    key = jax.random.PRNGKey(0)
+    for _ in range(WARMUP_ITERS):
+        key, sub = jax.random.split(key)
+        critic_state, gen_state, c_loss, _ = tr.train_round(critic_state, gen_state, real, sub)
+    jax.block_until_ready(c_loss)
+    t0 = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        key, sub = jax.random.split(key)
+        critic_state, gen_state, c_loss, _ = tr.train_round(critic_state, gen_state, real, sub)
+    jax.block_until_ready(c_loss)
+    # images/sec counts every critic batch + the generator batch
+    per_round = (cfg.n_critic + 1) * batch
+    return {
+        "metric": "wgan_gp_cifar10_images_per_sec_per_chip",
+        "value": TIMED_ITERS * per_round / (time.perf_counter() - t0),
+        "unit": "images/sec",
+    }
+
+
+CONFIGS = {
+    "1": bench_mnist,
+    "2": bench_tabular,
+    "3": bench_cifar10,
+    "4": bench_celeba64,
+    "5": bench_wgan_gp,
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="BASELINE.md bench harness")
+    p.add_argument("--config", default="1", choices=[*CONFIGS, "all"],
+                   help="BASELINE config number (default 1: DCGAN MNIST)")
+    p.add_argument("--json", default=None, help="also write full results here")
+    args = p.parse_args()
+
+    keys = list(CONFIGS) if args.config == "all" else [args.config]
+    results = []
+    failed = False
+    for k in keys:
+        try:
+            r = CONFIGS[k]()
+        except Exception as exc:  # keep earlier (expensive) results on failure
+            print(json.dumps({"config": k, "error": f"{type(exc).__name__}: {exc}"}))
+            failed = True
+            continue
+        base = BASELINES.get(r["metric"])
+        r["value"] = round(float(r["value"]), 2)
+        r["vs_baseline"] = round(r["value"] / base, 3) if base else 1.0
+        results.append(r)
+        print(json.dumps(r))
+        if args.json:  # flush after every config, not only at the end
+            with open(args.json, "w") as fh:
+                json.dump(results, fh, indent=2)
+    if failed:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

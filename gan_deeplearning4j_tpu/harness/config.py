@@ -17,6 +17,10 @@ from typing import Optional, Sequence
 
 @dataclasses.dataclass
 class ExperimentConfig:
+    # -- model family (BASELINE.md configs; "mnist" is the reference app) ----
+    # "mnist" | "tabular" | "image" (+aliases "cifar10"/"celeba64")
+    model_family: str = "mnist"
+
     # -- batching & shapes (dl4jGANComputerVision.java:66-81) ---------------
     batch_size_train: int = 200
     batch_size_pred: int = 500
@@ -68,13 +72,18 @@ class ExperimentConfig:
     profile_dir: Optional[str] = None
 
     def validate(self) -> "ExperimentConfig":
-        if self.num_features != self.height * self.width * self.channels:
+        if self.model_family != "tabular" and self.num_features != (
+            self.height * self.width * self.channels
+        ):
             raise ValueError(
                 f"num_features {self.num_features} != h*w*c "
                 f"{self.height * self.width * self.channels}"
             )
         if self.distributed not in ("none", "pmean", "param_averaging"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
+        from gan_deeplearning4j_tpu.models import registry
+
+        registry.get(self.model_family)  # raises on unknown family
         return self
 
     # -- overrides ------------------------------------------------------------

@@ -32,7 +32,7 @@ import numpy as np
 
 from gan_deeplearning4j_tpu.data import ArrayDataSetIterator, DevicePrefetchIterator
 from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
-from gan_deeplearning4j_tpu.models import dcgan_mnist
+from gan_deeplearning4j_tpu.models import registry
 from gan_deeplearning4j_tpu.nn import ComputationGraph
 from gan_deeplearning4j_tpu.parallel import (
     GraphTrainer,
@@ -64,41 +64,35 @@ class GanExperiment:
     def __init__(self, config: ExperimentConfig = ExperimentConfig(), mesh=None):
         self.config = config.validate()
         cfg = config
-        self.model_cfg = dcgan_mnist.DcganConfig(
-            height=cfg.height,
-            width=cfg.width,
-            channels=cfg.channels,
-            num_features=cfg.num_features,
-            num_classes=cfg.num_classes,
-            num_classes_dis=cfg.num_classes_dis,
-            z_size=cfg.z_size,
-            dis_learning_rate=cfg.dis_learning_rate,
-            gen_learning_rate=cfg.gen_learning_rate,
-            frozen_learning_rate=cfg.frozen_learning_rate,
-            seed=cfg.seed,
-            l2=cfg.l2,
-            grad_clip=cfg.grad_clip,
-        )
+        self.family = registry.get(cfg.model_family)
+        self.model_cfg = self.family.make_model_config(cfg)
+        self.dis_to_gan, self.gan_to_gen = self.family.sync_maps(self.model_cfg)
 
         if mesh is None and cfg.distributed != "none":
             mesh = TpuEnvironment().make_mesh()
         self.mesh = mesh
 
-        # the three graphs + transfer classifier (I4-I6, I11)
-        self.dis = dcgan_mnist.build_discriminator(self.model_cfg)
-        self.gen = dcgan_mnist.build_generator(self.model_cfg)
-        self.gan = dcgan_mnist.build_gan(self.model_cfg)
+        # the three graphs (+ MNIST's transfer classifier, I4-I6, I11)
+        self.dis = self.family.build_discriminator(self.model_cfg)
+        self.gen = self.family.build_generator(self.model_cfg)
+        self.gan = self.family.build_gan(self.model_cfg)
         dis_params = self.dis.init()
-        self.cv, cv_params = dcgan_mnist.build_transfer_classifier(
-            self.dis, dis_params, self.model_cfg
-        )
+        if self.family.build_transfer_classifier is not None:
+            self.cv, cv_params = self.family.build_transfer_classifier(
+                self.dis, dis_params, self.model_cfg
+            )
+        else:
+            self.cv, cv_params = None, None
 
         self.dis_trainer = self._make_trainer(self.dis)
         self.gan_trainer = self._make_trainer(self.gan)
-        self.cv_trainer = self._make_trainer(self.cv)
         self.dis_state = self.dis_trainer.init_state(params=dis_params)
         self.gan_state = self.gan_trainer.init_state()
-        self.cv_state = self.cv_trainer.init_state(params=cv_params)
+        if self.cv is not None:
+            self.cv_trainer = self._make_trainer(self.cv)
+            self.cv_state = self.cv_trainer.init_state(params=cv_params)
+        else:
+            self.cv_trainer, self.cv_state = None, None
         self.gen_params = self.gen.init()
         self._gen_fwd = jax.jit(lambda p, z: self.gen.output(p, z, train=False))
 
@@ -129,6 +123,7 @@ class GanExperiment:
             if all(
                 isinstance(t, GraphTrainer)
                 for t in (self.dis_trainer, self.gan_trainer, self.cv_trainer)
+                if t is not None
             )
             else None
         )
@@ -226,7 +221,7 @@ class GanExperiment:
                 self.dis, self.dis_trainer.optimizer, dis_state, fake, soft0
             )
             # (c) dis → gan frozen tail
-            gan_state = rebind(dis_state, gan_state, dcgan_mnist.DIS_TO_GAN)
+            gan_state = rebind(dis_state, gan_state, self.dis_to_gan)
             # (d) generator step through the frozen D on [z, ones]
             ones = jnp.ones((z_gan.shape[0], 1), jnp.float32)
             gan_state, g = one_step(
@@ -234,13 +229,16 @@ class GanExperiment:
             )
             # (e) gan → gen refresh; dis → classifier features
             gen_params = ComputationGraph.copy_params(
-                gan_state.params, gen_params, dcgan_mnist.GAN_TO_GEN
+                gan_state.params, gen_params, self.gan_to_gen
             )
-            cv_state = rebind(dis_state, cv_state, dcgan_mnist.DIS_TO_CV)
-            # (f) classifier step on the real labeled batch
-            cv_state, c = one_step(
-                self.cv, self.cv_trainer.optimizer, cv_state, real_f, real_l
-            )
+            if self.cv is not None:
+                cv_state = rebind(dis_state, cv_state, self.family.dis_to_cv)
+                # (f) classifier step on the real labeled batch
+                cv_state, c = one_step(
+                    self.cv, self.cv_trainer.optimizer, cv_state, real_f, real_l
+                )
+            else:  # family without a transfer classifier: cv_state is a dummy
+                c = jnp.float32(jnp.nan)
             return dis_state, gan_state, cv_state, gen_params, (d1 + d2) / 2.0, g, c
 
         kwargs = {"donate_argnums": (0, 1, 2, 3)}
@@ -344,7 +342,7 @@ class GanExperiment:
             sink.extend(d_losses)
 
         # (c) dis → gan frozen tail (:429-460)
-        self.gan_state = self._sync(self.dis_state, self.gan_state, dcgan_mnist.DIS_TO_GAN)
+        self.gan_state = self._sync(self.dis_state, self.gan_state, self.dis_to_gan)
 
         # (d) generator step through the frozen D: [z, ones] (:462-471)
         with self.timer.phase("train_gan") as sink:
@@ -357,18 +355,20 @@ class GanExperiment:
 
         # (e) gan → gen refresh (:473-510); dis → classifier features (:512-542)
         self.gen_params = ComputationGraph.copy_params(
-            self._copied_layers(self.gan_state.params, dcgan_mnist.GAN_TO_GEN),
+            self._copied_layers(self.gan_state.params, self.gan_to_gen),
             self.gen_params,
-            dcgan_mnist.GAN_TO_GEN,
+            self.gan_to_gen,
         )
-        self.cv_state = self._sync(self.dis_state, self.cv_state, dcgan_mnist.DIS_TO_CV)
+        cv_losses = []
+        if self.cv is not None:
+            self.cv_state = self._sync(self.dis_state, self.cv_state, self.family.dis_to_cv)
 
-        # (f) classifier step on the real labeled batch (:544-545)
-        with self.timer.phase("train_cv") as sink:
-            self.cv_state, cv_losses = self._fit_batch(
-                self.cv_trainer, self.cv_state, real_features, real_labels, b
-            )
-            sink.extend(cv_losses)
+            # (f) classifier step on the real labeled batch (:544-545)
+            with self.timer.phase("train_cv") as sink:
+                self.cv_state, cv_losses = self._fit_batch(
+                    self.cv_trainer, self.cv_state, real_features, real_labels, b
+                )
+                sink.extend(cv_losses)
 
         return {
             "d_loss": float(np.mean([float(l) for l in d_losses])) if d_losses else float("nan"),
@@ -392,6 +392,10 @@ class GanExperiment:
         """Batched test-set inference → ``{prefix}_test_predictions_{index}.csv``
         (:572-598): reset, stream batches through the classifier, vstack."""
         cfg = self.config
+        if self.cv is None:
+            raise ValueError(
+                f"family {self.family.name!r} has no transfer classifier to predict with"
+            )
         test_iterator.reset()
         chunks: List[np.ndarray] = []
         while test_iterator.has_next():
@@ -410,12 +414,14 @@ class GanExperiment:
         cfg = self.config
         os.makedirs(cfg.output_dir, exist_ok=True)
         out = []
-        for name, graph, state in (
+        models = [
             ("dis", self.dis, self.dis_state),
             ("gan", self.gan, self.gan_state),
             ("gen", self.gen, self.gen_params),
-            ("CV", self.cv, self.cv_state),
-        ):
+        ]
+        if self.cv is not None:
+            models.append(("CV", self.cv, self.cv_state))
+        for name, graph, state in models:
             path = os.path.join(cfg.output_dir, f"{cfg.file_prefix}_{name}_model.zip")
             write_model(path, graph, state, save_updater=True)
             out.append(path)
@@ -444,7 +450,11 @@ class GanExperiment:
                 if self.batch_counter % cfg.print_every == 0:
                     with self.timer.phase("export_manifold"):
                         self.export_manifold(index)
-                if test_iterator is not None and self.batch_counter % cfg.save_every == 0:
+                if (
+                    test_iterator is not None
+                    and self.cv is not None
+                    and self.batch_counter % cfg.save_every == 0
+                ):
                     with self.timer.phase("export_predictions"):
                         self.export_predictions(test_iterator, index)
                 if cfg.save_models:

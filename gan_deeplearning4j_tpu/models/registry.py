@@ -1,0 +1,122 @@
+"""GAN family registry — one uniform surface over the model zoo so the
+experiment harness/bench can run any BASELINE.md config through the same
+alternating loop (the reference's loop, dl4jGANComputerVision.java:408-621,
+is model-agnostic: it only needs the three graphs + the sync maps).
+
+A family provides: graph builders, the weight-sync maps, the synthetic data
+source for offline runs, and (MNIST only) the transfer classifier."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.models import dcgan_image, dcgan_mnist, mlp_gan
+
+
+@dataclasses.dataclass(frozen=True)
+class GanFamily:
+    """Uniform model-family handle consumed by GanExperiment/bench."""
+
+    name: str
+    make_model_config: Callable  # ExperimentConfig-like -> family config
+    build_discriminator: Callable
+    build_generator: Callable
+    build_gan: Callable
+    sync_maps: Callable  # family config -> (DIS_TO_GAN, GAN_TO_GEN)
+    synthetic_data: Callable  # (num, family config, seed) -> (N, F) float32
+    # MNIST: the dis-feature transfer classifier (SURVEY I11); None elsewhere
+    build_transfer_classifier: Optional[Callable] = None
+    dis_to_cv: Optional[Dict[str, str]] = None
+
+
+def _mnist_config(cfg) -> dcgan_mnist.DcganConfig:
+    return dcgan_mnist.DcganConfig(
+        height=cfg.height, width=cfg.width, channels=cfg.channels,
+        num_features=cfg.num_features, num_classes=cfg.num_classes,
+        num_classes_dis=cfg.num_classes_dis, z_size=cfg.z_size,
+        dis_learning_rate=cfg.dis_learning_rate,
+        gen_learning_rate=cfg.gen_learning_rate,
+        frozen_learning_rate=cfg.frozen_learning_rate,
+        seed=cfg.seed, l2=cfg.l2, grad_clip=cfg.grad_clip,
+    )
+
+
+def _mnist_synthetic(num: int, model_cfg, seed: int) -> np.ndarray:
+    from gan_deeplearning4j_tpu.data.mnist import synthetic_mnist
+
+    (x, _), _ = synthetic_mnist(num_train=num, num_test=1, seed=seed)
+    return x
+
+
+def _mlp_config(cfg) -> mlp_gan.MlpGanConfig:
+    return mlp_gan.MlpGanConfig(
+        num_features=cfg.num_features, z_size=cfg.z_size,
+        dis_learning_rate=cfg.dis_learning_rate,
+        gen_learning_rate=cfg.gen_learning_rate,
+        frozen_learning_rate=cfg.frozen_learning_rate,
+        seed=cfg.seed, l2=cfg.l2, grad_clip=cfg.grad_clip,
+    )
+
+
+def _image_config(cfg) -> dcgan_image.ImageGanConfig:
+    return dcgan_image.ImageGanConfig(
+        height=cfg.height, width=cfg.width, channels=cfg.channels,
+        z_size=cfg.z_size,
+        dis_learning_rate=cfg.dis_learning_rate,
+        gen_learning_rate=cfg.gen_learning_rate,
+        frozen_learning_rate=cfg.frozen_learning_rate,
+        seed=cfg.seed, l2=cfg.l2, grad_clip=cfg.grad_clip,
+    )
+
+
+_FAMILIES: Dict[str, GanFamily] = {
+    "mnist": GanFamily(
+        name="mnist",
+        make_model_config=_mnist_config,
+        build_discriminator=dcgan_mnist.build_discriminator,
+        build_generator=dcgan_mnist.build_generator,
+        build_gan=dcgan_mnist.build_gan,
+        sync_maps=lambda cfg: (dcgan_mnist.DIS_TO_GAN, dcgan_mnist.GAN_TO_GEN),
+        synthetic_data=_mnist_synthetic,
+        build_transfer_classifier=dcgan_mnist.build_transfer_classifier,
+        dis_to_cv=dcgan_mnist.DIS_TO_CV,
+    ),
+    "tabular": GanFamily(
+        name="tabular",
+        make_model_config=_mlp_config,
+        build_discriminator=mlp_gan.build_discriminator,
+        build_generator=mlp_gan.build_generator,
+        build_gan=mlp_gan.build_gan,
+        sync_maps=mlp_gan.sync_maps,
+        synthetic_data=lambda num, cfg, seed: mlp_gan.synthetic_transactions(
+            num, num_features=cfg.num_features, seed=seed
+        ),
+    ),
+    "image": GanFamily(
+        name="image",
+        make_model_config=_image_config,
+        build_discriminator=dcgan_image.build_discriminator,
+        build_generator=dcgan_image.build_generator,
+        build_gan=dcgan_image.build_gan,
+        sync_maps=dcgan_image.sync_maps,
+        synthetic_data=lambda num, cfg, seed: dcgan_image.synthetic_images(
+            num, cfg, seed=seed
+        ),
+    ),
+}
+# BASELINE.md config aliases
+_ALIASES = {"cifar10": "image", "celeba64": "image"}
+
+
+def names() -> Tuple[str, ...]:
+    return tuple(_FAMILIES) + tuple(_ALIASES)
+
+
+def get(name: str) -> GanFamily:
+    key = _ALIASES.get(name, name)
+    if key not in _FAMILIES:
+        raise KeyError(f"unknown model family {name!r}; known: {sorted(names())}")
+    return _FAMILIES[key]

@@ -156,3 +156,48 @@ class TestExperimentLoop:
 def _one_batch(n=16):
     (xtr, ytr), _ = synthetic_mnist(n, 1)
     return xtr, one_hot_np(ytr, 10)
+
+
+class TestFamilies:
+    """The generalized harness: the alternating loop over non-MNIST families."""
+
+    def test_tabular_family_iteration(self):
+        from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+
+        cfg = ExperimentConfig(
+            model_family="tabular", num_features=16, z_size=4,
+            batch_size_train=8, batch_size_pred=8, num_iterations=1,
+            save_models=False, height=1, width=1, channels=1,
+        )
+        exp = GanExperiment(cfg)
+        assert exp.cv is None and exp.cv_trainer is None
+        feats = exp.family.synthetic_data(8, exp.model_cfg, 0)
+        labels = np.eye(10, dtype=np.float32)[np.arange(8) % 10]
+        losses = exp.train_iteration(feats, labels)
+        assert np.isfinite(float(losses["d_loss"]))
+        assert np.isfinite(float(losses["g_loss"]))
+        assert np.isnan(float(losses["cv_loss"]))  # no classifier
+        # save_models writes 3 zips, predictions export refuses
+        with pytest.raises(ValueError):
+            exp.export_predictions(None, 1)
+
+    def test_image_family_iteration(self):
+        from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+
+        cfg = ExperimentConfig(
+            model_family="cifar10", height=8, width=8, channels=3,
+            num_features=192, z_size=4, batch_size_train=4, batch_size_pred=4,
+            num_iterations=1, save_models=False,
+        )
+        exp = GanExperiment(cfg)
+        feats = exp.family.synthetic_data(4, exp.model_cfg, 0)
+        labels = np.eye(10, dtype=np.float32)[np.arange(4) % 10]
+        losses = exp.train_iteration(feats, labels)
+        assert np.isfinite(float(losses["d_loss"]))
+        assert np.isfinite(float(losses["g_loss"]))
+
+    def test_unknown_family_rejected(self):
+        from gan_deeplearning4j_tpu.harness import ExperimentConfig
+
+        with pytest.raises(KeyError):
+            ExperimentConfig(model_family="bogus").validate()
