@@ -12,6 +12,7 @@ from gan_deeplearning4j_tpu.data.records import (
     ClassPathResource,
     CSVRecordReader,
     FileSplit,
+    write_csv,
     InMemoryRecordReader,
 )
 from gan_deeplearning4j_tpu.data.iterator import (
@@ -31,6 +32,7 @@ __all__ = [
     "ClassPathResource",
     "CSVRecordReader",
     "FileSplit",
+    "write_csv",
     "InMemoryRecordReader",
     "ArrayDataSetIterator",
     "DataSetIterator",

@@ -76,12 +76,20 @@ def write_mnist_csv(
 ) -> str:
     """Write the reference CSV layout: 784 feature columns then the label as
     column 785, ``%.2f`` formatted (gan.ipynb cell 2's np.savetxt calls)."""
+    import re
+
+    from gan_deeplearning4j_tpu.data.records import write_csv
+
     features = np.asarray(features, dtype=np.float32).reshape(len(labels), -1)
     table = np.concatenate(
         [features, np.asarray(labels, dtype=np.float32).reshape(-1, 1)], axis=1
     )
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    np.savetxt(path, table, delimiter=",", fmt=fmt)
+    m = re.fullmatch(r"%\.(\d+)f", fmt)
+    if m:  # fixed-precision formats go through the native fast path
+        write_csv(path, table, precision=int(m.group(1)))
+    else:
+        np.savetxt(path, table, delimiter=",", fmt=fmt)
     return path
 
 

@@ -87,6 +87,22 @@ def _parse_csv(path: str, skip_lines: int, delimiter: str) -> np.ndarray:
     )
 
 
+def write_csv(
+    path: str, array: np.ndarray, precision: int = 6, delimiter: str = ","
+) -> str:
+    """Write a float matrix as fixed-precision CSV, preferring the native C++
+    writer (the reference's export hot path :550-598 without per-scalar IO)."""
+    try:
+        from gan_deeplearning4j_tpu.native import csv_loader
+
+        if csv_loader.available():
+            return csv_loader.write_csv(path, array, delimiter=delimiter, precision=precision)
+    except ImportError:
+        pass
+    np.savetxt(path, np.asarray(array), delimiter=delimiter, fmt=f"%.{precision}f")
+    return path
+
+
 class CSVRecordReader(RecordReader):
     """``CSVRecordReader(skipLines, delimiter)`` analog. The whole file is
     parsed to one float32 matrix up front (the reference re-reads per record

@@ -30,7 +30,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gan_deeplearning4j_tpu.data import ArrayDataSetIterator, DevicePrefetchIterator
+from gan_deeplearning4j_tpu.data import (
+    ArrayDataSetIterator,
+    DevicePrefetchIterator,
+    write_csv,
+)
 from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
 from gan_deeplearning4j_tpu.models import registry
 from gan_deeplearning4j_tpu.nn import ComputationGraph
@@ -385,7 +389,7 @@ class GanExperiment:
         out = np.asarray(out).reshape(self._z_grid.shape[0], cfg.num_features)
         os.makedirs(cfg.output_dir, exist_ok=True)
         path = os.path.join(cfg.output_dir, f"{cfg.file_prefix}_out_{index}.csv")
-        np.savetxt(path, out, delimiter=",", fmt="%.6f")
+        write_csv(path, out, precision=6)
         return path
 
     def export_predictions(self, test_iterator, index: int) -> str:
@@ -406,7 +410,7 @@ class GanExperiment:
         path = os.path.join(
             cfg.output_dir, f"{cfg.file_prefix}_test_predictions_{index}.csv"
         )
-        np.savetxt(path, preds, delimiter=",", fmt="%.6f")
+        write_csv(path, preds, precision=6)
         return path
 
     def save_models(self) -> List[str]:

@@ -1,0 +1,11 @@
+"""Native (C++) runtime components, bound via ctypes with Python fallbacks.
+
+The reference's framework stack keeps its data layer and op executors in
+native code behind the JVM (libnd4j, DataVec — SURVEY §2.2 D2-D5, D13). The
+TPU rebuild's compute path is XLA (already native); this package holds the
+native pieces *around* the compute path — currently the CSV data layer
+(csv_loader) — built on demand with the system toolchain."""
+
+from gan_deeplearning4j_tpu.native import build, csv_loader
+
+__all__ = ["build", "csv_loader"]

@@ -1,0 +1,41 @@
+"""Build the native data-layer library with the system toolchain.
+
+One g++ invocation producing ``_gdt_native.so`` next to this file; rebuilt
+automatically when the source is newer than the binary. No pybind11 in this
+image — the ABI is plain C, bound with ctypes (csv_loader.py)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "csv_loader.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "_gdt_native.so")
+_lock = threading.Lock()
+
+
+def library_path() -> str:
+    return _LIB
+
+
+def needs_build() -> bool:
+    return not os.path.exists(_LIB) or os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile if needed. Returns the library path, or None if the toolchain
+    is unavailable/fails (callers fall back to the numpy path)."""
+    with _lock:
+        if not force and not needs_build():
+            return _LIB
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            "-o", _LIB, _SRC,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        return _LIB

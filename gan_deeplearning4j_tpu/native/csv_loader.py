@@ -1,0 +1,104 @@
+"""ctypes binding for the native CSV parser/writer (src/csv_loader.cpp).
+
+The TPU-framework analog of DataVec's native-backed CSV layer (SURVEY §2.2
+D13): parse-to-dense-float32 at memory bandwidth, multithreaded. All entry
+points degrade gracefully — ``available()`` is False when the toolchain or
+the built library is missing, and callers (data/records.py) fall back to
+numpy."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.native import build as _build
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    path = _build.build()
+    if path is None or not os.path.exists(path):
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.gdt_csv_read.restype = ctypes.c_int
+        lib.gdt_csv_read.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.gdt_csv_free.restype = None
+        lib.gdt_csv_free.argtypes = [ctypes.POINTER(ctypes.c_float)]
+        lib.gdt_csv_write.restype = ctypes.c_int
+        lib.gdt_csv_write.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long, ctypes.c_long, ctypes.c_char, ctypes.c_int,
+        ]
+    except OSError:
+        _load_failed = True
+        return None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+_ERRORS = {
+    1: "cannot open/read file",
+    2: "ragged rows (inconsistent column counts)",
+    3: "parse failure (non-numeric field)",
+    4: "empty input",
+}
+
+
+def load_csv(path: str, skip_lines: int = 0, delimiter: str = ",") -> np.ndarray:
+    """Parse a numeric CSV into an (N, C) float32 array via the native lib."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native CSV library unavailable")
+    data = ctypes.POINTER(ctypes.c_float)()
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    status = lib.gdt_csv_read(
+        os.fspath(path).encode(), skip_lines, delimiter.encode()[:1],
+        ctypes.byref(data), ctypes.byref(rows), ctypes.byref(cols),
+    )
+    if status != 0:
+        raise ValueError(
+            f"native CSV parse of {path!r} failed: {_ERRORS.get(status, status)}"
+        )
+    try:
+        # copy out of the malloc'd buffer into numpy-owned memory
+        out = np.ctypeslib.as_array(data, shape=(rows.value, cols.value)).copy()
+    finally:
+        lib.gdt_csv_free(data)
+    return out
+
+
+def write_csv(path: str, array: np.ndarray, delimiter: str = ",", precision: int = 6) -> str:
+    """Write an (N, C) array as CSV (%.{precision}f) via the native lib."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native CSV library unavailable")
+    arr = np.ascontiguousarray(np.asarray(array, dtype=np.float32))
+    if arr.ndim != 2:
+        raise ValueError(f"expected a 2-D array, got shape {arr.shape}")
+    status = lib.gdt_csv_write(
+        os.fspath(path).encode(),
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        arr.shape[0], arr.shape[1], delimiter.encode()[:1], precision,
+    )
+    if status != 0:
+        raise ValueError(f"native CSV write to {path!r} failed")
+    return path

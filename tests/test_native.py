@@ -1,0 +1,132 @@
+"""Native C++ CSV layer: build, parity vs numpy, error paths, fallback."""
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.data.records import CSVRecordReader, FileSplit, write_csv
+from gan_deeplearning4j_tpu.native import build, csv_loader
+
+pytestmark = pytest.mark.skipif(
+    not csv_loader.available(), reason="native toolchain unavailable"
+)
+
+
+class TestNativeRead:
+    def test_parity_with_numpy(self, tmp_path):
+        rng = np.random.default_rng(0)
+        arr = (rng.random((50, 17)) * 200 - 100).astype(np.float32)
+        p = tmp_path / "a.csv"
+        np.savetxt(p, arr, delimiter=",", fmt="%.6f")
+        native = csv_loader.load_csv(str(p))
+        ref = np.loadtxt(p, delimiter=",", dtype=np.float32, ndmin=2)
+        np.testing.assert_array_equal(native, ref)
+
+    def test_exponent_nan_inf_and_integers(self, tmp_path):
+        p = tmp_path / "b.csv"
+        p.write_text("1e-3,2.5E2,-4,nan,inf,-inf,0,666\n")
+        out = csv_loader.load_csv(str(p))
+        assert out.shape == (1, 8)
+        np.testing.assert_allclose(out[0, :3], [1e-3, 250.0, -4.0])
+        assert np.isnan(out[0, 3])
+        assert np.isposinf(out[0, 4]) and np.isneginf(out[0, 5])
+        assert out[0, 6] == 0.0 and out[0, 7] == 666.0
+
+    def test_skip_lines_crlf_and_trailing_newline(self, tmp_path):
+        p = tmp_path / "c.csv"
+        p.write_text("header,line\r\n1.5,2.5\r\n3.5,4.5\n\n")
+        out = csv_loader.load_csv(str(p), skip_lines=1)
+        np.testing.assert_array_equal(out, [[1.5, 2.5], [3.5, 4.5]])
+
+    def test_ragged_rows_rejected(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("1,2,3\n4,5\n")
+        with pytest.raises(ValueError, match="ragged"):
+            csv_loader.load_csv(str(p))
+
+    def test_non_numeric_rejected(self, tmp_path):
+        p = tmp_path / "e.csv"
+        p.write_text("1,2\n3,abc\n")
+        with pytest.raises(ValueError, match="parse"):
+            csv_loader.load_csv(str(p))
+
+    def test_empty_rejected(self, tmp_path):
+        p = tmp_path / "f.csv"
+        p.write_text("")
+        with pytest.raises(ValueError, match="empty"):
+            csv_loader.load_csv(str(p))
+
+    def test_missing_file(self, tmp_path):
+        with pytest.raises(ValueError, match="open"):
+            csv_loader.load_csv(str(tmp_path / "nope.csv"))
+
+
+class TestNativeWrite:
+    def test_roundtrip_and_format_parity(self, tmp_path):
+        rng = np.random.default_rng(1)
+        arr = (rng.random((40, 7)) * 2000 - 1000).astype(np.float32)
+        p_nat = tmp_path / "n.csv"
+        p_np = tmp_path / "p.csv"
+        csv_loader.write_csv(str(p_nat), arr, precision=4)
+        np.savetxt(p_np, arr, delimiter=",", fmt="%.4f")
+        a = np.loadtxt(p_nat, delimiter=",", ndmin=2)
+        b = np.loadtxt(p_np, delimiter=",", ndmin=2)
+        # same values to within the last printed digit (tie-breaking at the
+        # half-ulp boundary may differ from printf's)
+        np.testing.assert_allclose(a, b, atol=1.01e-4)
+
+    def test_special_values(self, tmp_path):
+        arr = np.array([[np.nan, np.inf, -np.inf, 1e20, -0.0]], np.float32)
+        p = tmp_path / "s.csv"
+        csv_loader.write_csv(str(p), arr, precision=2)
+        txt = p.read_text()
+        assert "nan" in txt and "inf" in txt
+        back = csv_loader.load_csv(str(p))
+        assert np.isnan(back[0, 0]) and np.isposinf(back[0, 1])
+
+    def test_bad_shape_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="2-D"):
+            csv_loader.write_csv(str(tmp_path / "x.csv"), np.zeros(3))
+
+
+class TestIntegration:
+    def test_record_reader_uses_native(self, tmp_path):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4) / 7.0
+        p = tmp_path / "r.csv"
+        write_csv(str(p), arr, precision=6)
+        reader = CSVRecordReader(0, ",")
+        reader.initialize(FileSplit(str(p)))
+        np.testing.assert_allclose(reader.data, arr, atol=1e-6)
+
+    def test_write_csv_fallback(self, tmp_path, monkeypatch):
+        # when the native lib is unavailable the numpy path produces the file
+        monkeypatch.setattr(csv_loader, "available", lambda: False)
+        arr = np.ones((2, 2), np.float32) / 3.0
+        p = tmp_path / "f.csv"
+        write_csv(str(p), arr, precision=3)
+        np.testing.assert_allclose(
+            np.loadtxt(p, delimiter=",", ndmin=2), np.full((2, 2), 0.333), atol=1e-9
+        )
+
+    def test_rebuild_is_cached(self):
+        path = build.build()
+        assert path is not None
+        assert not build.needs_build()
+
+    def test_large_values_not_corrupted(self, tmp_path):
+        # regression: v * 10^precision overflowing uint64 must take the
+        # printf path, not silently emit zeros
+        arr = np.array([[1e14, 2e13, 3.4e38, -1.5e16]], np.float32)
+        p = tmp_path / "big.csv"
+        csv_loader.write_csv(str(p), arr, precision=6)
+        back = csv_loader.load_csv(str(p))
+        np.testing.assert_allclose(back, arr, rtol=1e-6)
+        assert "\x00" not in p.read_text()
+
+    def test_max_float_high_precision_no_nul_bytes(self, tmp_path):
+        arr = np.full((300, 4), np.finfo(np.float32).max, np.float32)
+        p = tmp_path / "max.csv"
+        csv_loader.write_csv(str(p), arr, precision=17)
+        txt = p.read_text()
+        assert "\x00" not in txt
+        back = csv_loader.load_csv(str(p))
+        np.testing.assert_allclose(back, arr, rtol=1e-6)
