@@ -57,9 +57,45 @@ def main(argv=None) -> int:
     )
 
     experiment = GanExperiment(config)
+    if config.resume:
+        restored = experiment.load_models()
+        print(f"Resumed from iteration {restored}")
     result = experiment.run(train_it, test_it)
     print(f"Done: {result['iterations']} iterations")
     print(experiment.timer.report())
+
+    # offline eval — the gan.ipynb cell-6 flow, in-process (accuracy on the
+    # latest predictions export + the latent-manifold PNG)
+    if experiment.cv is not None and result["iterations"] > 0:
+        from gan_deeplearning4j_tpu.eval import accuracy_from_csvs, render_manifold
+
+        def latest(pattern: str):
+            """Highest-index export matching {prefix}_{pattern}_{N}.csv
+            (exports follow print_every/save_every cadences, so the final
+            iteration may not have one)."""
+            candidates = []
+            for name in os.listdir(config.output_dir):
+                m = re.fullmatch(
+                    re.escape(config.file_prefix) + "_" + pattern + r"_(\d+)\.csv", name
+                )
+                if m:
+                    candidates.append((int(m.group(1)), name))
+            return os.path.join(config.output_dir, max(candidates)[1]) if candidates else None
+
+        preds = latest("test_predictions")
+        manifold = latest("out")
+        if preds:
+            acc = accuracy_from_csvs(preds, test_csv, config.num_features)
+            print(f"Transfer-classifier accuracy: {acc * 100:.2f}%")
+        if manifold:
+            png = render_manifold(
+                manifold,
+                os.path.join(config.output_dir, "DCGAN_Generated_Images.png"),
+                grid=config.latent_grid,
+                side=config.height,
+                channels=config.channels,
+            )
+            print(f"Manifold image: {png}")
     return 0
 
 

@@ -76,20 +76,16 @@ def write_mnist_csv(
 ) -> str:
     """Write the reference CSV layout: 784 feature columns then the label as
     column 785, ``%.2f`` formatted (gan.ipynb cell 2's np.savetxt calls)."""
-    import re
-
-    from gan_deeplearning4j_tpu.data.records import write_csv
-
     features = np.asarray(features, dtype=np.float32).reshape(len(labels), -1)
     table = np.concatenate(
         [features, np.asarray(labels, dtype=np.float32).reshape(-1, 1)], axis=1
     )
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    m = re.fullmatch(r"%\.(\d+)f", fmt)
-    if m:  # fixed-precision formats go through the native fast path
-        write_csv(path, table, precision=int(m.group(1)))
-    else:
-        np.savetxt(path, table, delimiter=",", fmt=fmt)
+    # stays on np.savetxt deliberately: prepared datasets must be byte-stable
+    # across machines, and the native writer's tie-rounding (half-away-from-
+    # zero) differs from printf's at exact halves. The hot export paths use
+    # the native writer; one-time data prep does not need it.
+    np.savetxt(path, table, delimiter=",", fmt=fmt)
     return path
 
 

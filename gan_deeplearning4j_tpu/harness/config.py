@@ -49,6 +49,7 @@ class ExperimentConfig:
     output_dir: str = "output"
     file_prefix: str = "mnist"
     save_models: bool = True
+    resume: bool = False  # restore states from output_dir before training
 
     # -- label softening (:404-406) ------------------------------------------
     label_softening: float = 0.05

@@ -431,6 +431,39 @@ class GanExperiment:
             out.append(path)
         return out
 
+    def load_models(self, directory: Optional[str] = None) -> int:
+        """Resume: restore every state ``save_models`` wrote (params + updater
+        + step — the capability the reference's saveUpdater=true format
+        implies but never exercises, SURVEY §5 checkpoint/resume). Returns
+        the restored iteration count."""
+        from gan_deeplearning4j_tpu.utils.serializer import ModelSerializer, read_model
+
+        cfg = self.config
+        prefix = os.path.join(directory or cfg.output_dir, cfg.file_prefix)
+
+        def _placed(state):
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                return jax.device_put(state, NamedSharding(self.mesh, PartitionSpec()))
+            return state
+
+        self.dis_state = _placed(
+            ModelSerializer.restore_train_state(f"{prefix}_dis_model.zip", self.dis_trainer)
+        )
+        self.gan_state = _placed(
+            ModelSerializer.restore_train_state(f"{prefix}_gan_model.zip", self.gan_trainer)
+        )
+        if self.cv is not None:
+            self.cv_state = _placed(
+                ModelSerializer.restore_train_state(f"{prefix}_CV_model.zip", self.cv_trainer)
+            )
+        _, gen_params, _, _ = read_model(f"{prefix}_gen_model.zip", load_updater=False)
+        self.gen_params = _placed(gen_params)
+        # the gan graph steps once per loop iteration — use it as the counter
+        self.batch_counter = int(self.gan_state.step)
+        return self.batch_counter
+
     # -- the loop (I14) --------------------------------------------------
     def run(self, train_iterator, test_iterator=None) -> Dict:
         cfg = self.config

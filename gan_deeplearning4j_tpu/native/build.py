@@ -21,21 +21,35 @@ def library_path() -> str:
 
 
 def needs_build() -> bool:
-    return not os.path.exists(_LIB) or os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+    if not os.path.exists(_LIB):
+        return True
+    try:
+        return os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+    except OSError:
+        return False  # prebuilt .so shipped without src/ — use it as-is
 
 
 def build(force: bool = False) -> Optional[str]:
     """Compile if needed. Returns the library path, or None if the toolchain
-    is unavailable/fails (callers fall back to the numpy path)."""
+    is unavailable/fails (callers fall back to the numpy path). The compile
+    goes to a temp file + atomic rename so concurrent processes never dlopen
+    a partially written .so."""
     with _lock:
         if not force and not needs_build():
             return _LIB
+        tmp = _LIB + f".tmp.{os.getpid()}"
         cmd = [
             "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            "-o", _LIB, _SRC,
+            "-o", tmp, _SRC,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)
         except (OSError, subprocess.SubprocessError):
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
             return None
         return _LIB

@@ -11,7 +11,11 @@ from gan_deeplearning4j_tpu.runtime.dtype import (
     default_dtype_scope,
 )
 from gan_deeplearning4j_tpu.runtime.prng import RngStream
-from gan_deeplearning4j_tpu.runtime.environment import TpuEnvironment, backend_info
+from gan_deeplearning4j_tpu.runtime.environment import (
+    TpuEnvironment,
+    backend_info,
+    initialize_distributed,
+)
 
 __all__ = [
     "get_default_dtype",
@@ -20,4 +24,5 @@ __all__ = [
     "RngStream",
     "TpuEnvironment",
     "backend_info",
+    "initialize_distributed",
 ]

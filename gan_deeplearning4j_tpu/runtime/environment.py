@@ -39,6 +39,37 @@ def backend_info() -> dict:
     }
 
 
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Multi-host coordination — the Spark-driver analog (SURVEY §2.4).
+
+    The reference coordinates workers through a Spark driver
+    (dl4jGANComputerVision.java:317-330); on TPU pods the host processes
+    coordinate through the JAX distributed runtime and the devices talk over
+    ICI/DCN via XLA collectives. On TPU pods with a metadata service all
+    arguments auto-detect; pass them explicitly elsewhere. Safe to call when
+    already initialized (no-op). Returns backend_info() for logging."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # already initialized (idempotent re-entry) is fine; propagate the
+        # rest. jax phrases this either "already initialized" or
+        # "distributed.initialize should only be called once" by version.
+        msg = str(e).lower()
+        if "already" not in msg and "only be called once" not in msg:
+            raise
+    info = backend_info()
+    logger.info("Distributed runtime: %s", info)
+    return info
+
+
 @dataclasses.dataclass
 class TpuEnvironment:
     """Runtime configuration (analog of the CUDA env block I3, SURVEY §2.1).

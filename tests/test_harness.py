@@ -201,3 +201,39 @@ class TestFamilies:
 
         with pytest.raises(KeyError):
             ExperimentConfig(model_family="bogus").validate()
+
+
+class TestResume:
+    def test_save_then_load_roundtrip(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+
+        cfg = ExperimentConfig(
+            batch_size_train=8, batch_size_pred=8, num_iterations=1,
+            output_dir=str(tmp_path), save_models=True,
+        )
+        exp = GanExperiment(cfg)
+        (x, y_int), _ = synthetic_mnist(num_train=8, num_test=1, seed=0)
+        y = one_hot_np(y_int, 10)
+        exp.train_iteration(x, y)
+        exp.save_models()
+
+        exp2 = GanExperiment(cfg)
+        restored = exp2.load_models()
+        assert restored == int(exp.gan_state.step)
+        import jax
+
+        def assert_tree_equal(t1, t2):
+            jax.tree_util.tree_map(
+                lambda u, v: np.testing.assert_array_equal(np.asarray(u), np.asarray(v)),
+                t1, t2,
+            )
+
+        assert_tree_equal(exp.dis_state.params, exp2.dis_state.params)
+        assert_tree_equal(exp.dis_state.opt_state, exp2.dis_state.opt_state)
+        assert_tree_equal(exp.gan_state.params, exp2.gan_state.params)
+        assert_tree_equal(exp.cv_state.params, exp2.cv_state.params)
+        assert_tree_equal(exp.gen_params, exp2.gen_params)
+
+        # resumed training proceeds from the restored counter
+        exp2.train_iteration(x, y)
+        assert int(exp2.gan_state.step) == restored + 1
