@@ -51,6 +51,12 @@ class ExperimentConfig:
     save_models: bool = True
     resume: bool = False  # restore states from output_dir before training
 
+    # -- WGAN-GP (BASELINE.md config 5; ignored by the XENT families) --------
+    # critic steps per generator step; the incoming train batch is split into
+    # n_critic equal critic minibatches (batch_size_train % n_critic == 0)
+    n_critic: int = 5
+    gp_lambda: float = 10.0
+
     # -- label softening (:404-406) ------------------------------------------
     label_softening: float = 0.05
     # The reference samples the ±0.05·randn noise ONCE and reuses it every
@@ -67,6 +73,11 @@ class ExperimentConfig:
     batch_size_per_worker: int = 200
     prefetch: int = 0  # workerPrefetchNumBatches (:328); >0 enables device prefetch
     use_accelerator: bool = True  # the useGpu flag (:92)
+    # Mixed precision: "bf16" runs matmuls/convs in bfloat16 on the MXU with
+    # f32 accumulation (params stay f32); None/"f32" computes in f32. The
+    # reference pins global f32 (Nd4j.setDataType, :105) — bf16 is the
+    # TPU-native analog of its cuDNN tensor-core path (Java/pom.xml:124-128).
+    compute_dtype: Optional[str] = None
 
     # -- observability --------------------------------------------------------
     metrics_jsonl: Optional[str] = None
@@ -82,9 +93,24 @@ class ExperimentConfig:
             )
         if self.distributed not in ("none", "pmean", "param_averaging"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
+        from gan_deeplearning4j_tpu.runtime.dtype import parse_compute_dtype
+
+        parse_compute_dtype(self.compute_dtype)  # raises on unknown dtype
         from gan_deeplearning4j_tpu.models import registry
 
-        registry.get(self.model_family)  # raises on unknown family
+        family = registry.get(self.model_family)  # raises on unknown family
+        if family.name == "wgan_gp":
+            if self.n_critic < 1 or self.batch_size_train % self.n_critic:
+                raise ValueError(
+                    f"wgan_gp: batch_size_train {self.batch_size_train} must be "
+                    f"divisible by n_critic {self.n_critic}"
+                )
+            if self.distributed == "param_averaging":
+                raise ValueError(
+                    "wgan_gp supports distributed='pmean' (per-step sync over "
+                    "the mesh); k-step parameter averaging is a reference-"
+                    "parity mode for the XENT families"
+                )
         return self
 
     # -- overrides ------------------------------------------------------------

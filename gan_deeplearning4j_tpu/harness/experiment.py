@@ -44,11 +44,23 @@ from gan_deeplearning4j_tpu.parallel import (
     TrainState,
 )
 from gan_deeplearning4j_tpu.runtime import TpuEnvironment
+from gan_deeplearning4j_tpu.runtime.dtype import (
+    compute_dtype_scope,
+    parse_compute_dtype,
+)
 from gan_deeplearning4j_tpu.utils import write_model
 from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
 from gan_deeplearning4j_tpu.utils.profiling import PhaseTimer, device_trace
 
 logger = logging.getLogger(__name__)
+
+
+def shape_struct(tree):
+    """Pytree of ShapeDtypeStructs mirroring ``tree`` — for AOT lowering
+    (the FLOPs cost model) without touching real buffers."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype), tree
+    )
 
 
 def latent_grid(n: int, z_size: int = 2) -> np.ndarray:
@@ -68,6 +80,11 @@ class GanExperiment:
     def __init__(self, config: ExperimentConfig = ExperimentConfig(), mesh=None):
         self.config = config.validate()
         cfg = config
+        # Mixed precision: ops read the compute dtype at TRACE time, so every
+        # jitted program built/first-called under this experiment must trace
+        # inside a compute_dtype_scope (train_iteration and the exports wrap
+        # themselves; see those methods).
+        self._compute_dtype = parse_compute_dtype(cfg.compute_dtype)
         self.family = registry.get(cfg.model_family)
         self.model_cfg = self.family.make_model_config(cfg)
         self.dis_to_gan, self.gan_to_gen = self.family.sync_maps(self.model_cfg)
@@ -269,6 +286,12 @@ class GanExperiment:
 
     # ------------------------------------------------------------------
     def train_iteration(self, real_features, real_labels) -> Dict:
+        """One full alternating iteration (§3.2) under the configured compute
+        dtype (jit traces happen on the first call, inside the scope)."""
+        with compute_dtype_scope(self._compute_dtype):
+            return self._train_iteration(real_features, real_labels)
+
+    def _train_iteration(self, real_features, real_labels) -> Dict:
         """One full alternating iteration (§3.2). Inputs are the real batch:
         features (B, num_features) in [0,1] and one-hot labels (B, classes).
 
@@ -279,6 +302,15 @@ class GanExperiment:
         floats. ``run()`` normalizes to floats before logging."""
         cfg = self.config
         b = int(real_features.shape[0])
+        if b > self._eps_real.shape[0]:
+            # A batch larger than batch_size_train would silently truncate the
+            # once-sampled noise (numpy slicing) and poison the soft-label
+            # cache. Extend the noise instead — the extension is itself drawn
+            # once and reused, preserving the reference's sample-once quirk
+            # (:404-406) for every batch size seen.
+            extra = b - self._eps_real.shape[0]
+            self._eps_real = np.concatenate([self._eps_real, self._soft_noise(extra)])
+            self._eps_fake = np.concatenate([self._eps_fake, self._soft_noise(extra)])
         eps_r, eps_f = self._eps_real[:b], self._eps_fake[:b]
         if cfg.resample_label_noise:
             eps_r, eps_f = self._soft_noise(b), self._soft_noise(b)
@@ -380,12 +412,40 @@ class GanExperiment:
             "cv_loss": float(np.mean([float(l) for l in cv_losses])) if cv_losses else float("nan"),
         }
 
+    # -- cost model ------------------------------------------------------
+    def flops_per_iteration(self, batch_size: Optional[int] = None) -> Optional[float]:
+        """FLOPs of one full alternating iteration from XLA's post-optimization
+        cost analysis of the fused program (exact for what actually runs —
+        fwd+bwd for dis(×2)/gan/cv plus the sampler forward). None when the
+        phased path is active (param-averaging) or the backend exposes no
+        cost model. Feeds the bench's MFU line (BASELINE.json metric)."""
+        if self._fused is None:
+            return None
+        cfg = self.config
+        b = batch_size or cfg.batch_size_train
+        f32 = jnp.float32
+        struct = shape_struct
+        args = (
+            struct(self.dis_state), struct(self.gan_state), struct(self.cv_state),
+            struct(self.gen_params),
+            jax.ShapeDtypeStruct((b, cfg.num_features), f32),
+            jax.ShapeDtypeStruct((b, cfg.num_classes), f32),
+            jax.ShapeDtypeStruct((b, 1), f32),
+            jax.ShapeDtypeStruct((b, 1), f32),
+        )
+        with compute_dtype_scope(self._compute_dtype):
+            cost = self._fused.lower(*args).compile().cost_analysis()
+        if not cost or "flops" not in cost:
+            return None
+        return float(cost["flops"])
+
     # -- exports (I15) --------------------------------------------------
     def export_manifold(self, index: int) -> str:
         """Decode the z-grid and write ``{prefix}_out_{index}.csv`` —
         (grid², num_features) rows, one batched host fetch (:550-570)."""
         cfg = self.config
-        out = self._gen_fwd(self.gen_params, jnp.asarray(self._z_grid))
+        with compute_dtype_scope(self._compute_dtype):
+            out = self._gen_fwd(self.gen_params, jnp.asarray(self._z_grid))
         out = np.asarray(out).reshape(self._z_grid.shape[0], cfg.num_features)
         os.makedirs(cfg.output_dir, exist_ok=True)
         path = os.path.join(cfg.output_dir, f"{cfg.file_prefix}_out_{index}.csv")
@@ -402,9 +462,12 @@ class GanExperiment:
             )
         test_iterator.reset()
         chunks: List[np.ndarray] = []
-        while test_iterator.has_next():
-            batch = test_iterator.next()
-            chunks.append(np.asarray(self.cv_trainer.output(self.cv_state, batch.features)))
+        with compute_dtype_scope(self._compute_dtype):
+            while test_iterator.has_next():
+                batch = test_iterator.next()
+                chunks.append(
+                    np.asarray(self.cv_trainer.output(self.cv_state, batch.features))
+                )
         preds = np.vstack(chunks) if chunks else np.zeros((0, cfg.num_classes))
         os.makedirs(cfg.output_dir, exist_ok=True)
         path = os.path.join(

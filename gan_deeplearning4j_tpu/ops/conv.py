@@ -54,7 +54,12 @@ def conv2d(x, w, b=None, *, stride: IntPair = 1, padding: IntPair = 0):
         window_strides=(sh, sw),
         padding=((ph, ph), (pw, pw)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
+        # No preferred_element_type under mixed precision: jax 0.9's conv
+        # transpose rule rejects bf16 inputs with an f32 preference (the
+        # cotangent arrives f32 against a bf16 operand). The MXU still
+        # accumulates bf16 convs in f32 internally; only the stored output
+        # is bf16, upcast on the next line.
+        **({} if cdt != x.dtype else {"preferred_element_type": jnp.float32}),
     )
     y = y.astype(out_dtype)
     if b is not None:
@@ -81,7 +86,8 @@ def conv2d_transpose(x, w, b=None, *, stride: IntPair = 1, padding: IntPair = 0)
         strides=(sh, sw),
         padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32,
+        # see conv2d: omit the f32 preference under mixed precision
+        **({} if cdt != x.dtype else {"preferred_element_type": jnp.float32}),
     )
     y = y.astype(out_dtype)
     if b is not None:

@@ -54,6 +54,20 @@ def default_dtype_scope(dtype):
         st.default_dtype = prev
 
 
+def parse_compute_dtype(name):
+    """Map a config/CLI string to a compute dtype: ``"bf16"``/``"bfloat16"``
+    → bfloat16 mixed precision; ``None``/``"f32"``/``"float32"`` → full
+    precision (None, i.e. compute in the default dtype)."""
+    if not isinstance(name, str):
+        return name
+    key = name.lower()
+    if key in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    if key in ("f32", "float32", "none", ""):
+        return None
+    raise ValueError(f"unknown compute dtype {name!r} (use 'bf16' or 'f32')")
+
+
 @contextlib.contextmanager
 def compute_dtype_scope(dtype):
     st = _get_state()
