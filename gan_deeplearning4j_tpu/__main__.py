@@ -17,9 +17,10 @@ from gan_deeplearning4j_tpu.data import (
     CSVRecordReader,
     FileSplit,
     RecordReaderDataSetIterator,
+    write_csv,
 )
 from gan_deeplearning4j_tpu.data.mnist import prepare_mnist
-from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+from gan_deeplearning4j_tpu.harness import ExperimentConfig, make_experiment
 from gan_deeplearning4j_tpu.runtime import backend_info
 
 
@@ -27,6 +28,25 @@ def _csv_iterator(path: str, batch: int, label_index: int, num_classes: int):
     reader = CSVRecordReader(0, ",")
     reader.initialize(FileSplit(path))
     return RecordReaderDataSetIterator(reader, batch, label_index, num_classes)
+
+
+def _prepare_synthetic(config: ExperimentConfig, experiment) -> None:
+    """Family-appropriate synthetic CSVs (features…,label) for non-MNIST
+    families — MNIST keeps the reference's exact file contract via
+    ``prepare_mnist`` (gan.ipynb cell 2)."""
+    import numpy as np
+
+    os.makedirs(config.data_dir, exist_ok=True)
+    for split, n, seed in (
+        ("train", 2 * config.batch_size_train, 0),
+        ("test", config.batch_size_pred, 1),
+    ):
+        feats = experiment.family.synthetic_data(n, experiment.model_cfg, seed)
+        labels = (np.arange(n) % config.num_classes).reshape(-1, 1).astype(np.float32)
+        path = os.path.join(
+            config.data_dir, f"{config.file_prefix}_{split}.csv"
+        )
+        write_csv(path, np.hstack([feats, labels]), precision=6)
 
 
 def main(argv=None) -> int:
@@ -44,11 +64,16 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     print("Execution backend:", backend_info())
 
+    experiment = make_experiment(config)
+
     train_csv = os.path.join(config.data_dir, f"{config.file_prefix}_train.csv")
     test_csv = os.path.join(config.data_dir, f"{config.file_prefix}_test.csv")
     if not (os.path.exists(train_csv) and os.path.exists(test_csv)):
-        print(f"No CSVs under {config.data_dir!r}; generating synthetic MNIST there.")
-        prepare_mnist(config.data_dir, prefix=config.file_prefix)
+        print(f"No CSVs under {config.data_dir!r}; generating synthetic data there.")
+        if config.model_family == "mnist":
+            prepare_mnist(config.data_dir, prefix=config.file_prefix)
+        else:
+            _prepare_synthetic(config, experiment)
 
     train_it = _csv_iterator(
         train_csv, config.batch_size_train, config.num_features, config.num_classes
@@ -56,8 +81,6 @@ def main(argv=None) -> int:
     test_it = _csv_iterator(
         test_csv, config.batch_size_pred, config.num_features, config.num_classes
     )
-
-    experiment = GanExperiment(config)
     if config.resume:
         restored = experiment.load_models()
         print(f"Resumed from iteration {restored}")
@@ -66,8 +89,9 @@ def main(argv=None) -> int:
     print(experiment.timer.report())
 
     # offline eval — the gan.ipynb cell-6 flow, in-process (accuracy on the
-    # latest predictions export + the latent-manifold PNG)
-    if experiment.cv is not None and result["iterations"] > 0:
+    # latest predictions export + the latent-manifold PNG). Families without
+    # a transfer classifier still get the manifold image.
+    if result["iterations"] > 0:
         from gan_deeplearning4j_tpu.eval import accuracy_from_csvs, render_manifold
 
         def latest(pattern: str):
@@ -83,7 +107,7 @@ def main(argv=None) -> int:
                     candidates.append((int(m.group(1)), name))
             return os.path.join(config.output_dir, max(candidates)[1]) if candidates else None
 
-        preds = latest("test_predictions")
+        preds = latest("test_predictions") if experiment.cv is not None else None
         manifold = latest("out")
         if preds:
             acc = accuracy_from_csvs(preds, test_csv, config.num_features)

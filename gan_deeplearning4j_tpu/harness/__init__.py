@@ -11,4 +11,17 @@ overridable) and :class:`GanExperiment` (the loop).
 from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
 from gan_deeplearning4j_tpu.harness.experiment import GanExperiment
 
-__all__ = ["ExperimentConfig", "GanExperiment"]
+
+def make_experiment(config: ExperimentConfig, mesh=None):
+    """Experiment factory: dispatches to the family's custom experiment class
+    (wgan_gp) or the standard three-graph :class:`GanExperiment`. The CLI and
+    bench go through here so every registry family is a first-class run."""
+    from gan_deeplearning4j_tpu.models import registry
+
+    family = registry.get(config.model_family)
+    if family.make_experiment is not None:
+        return family.make_experiment(config, mesh)
+    return GanExperiment(config, mesh=mesh)
+
+
+__all__ = ["ExperimentConfig", "GanExperiment", "make_experiment"]

@@ -13,7 +13,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from gan_deeplearning4j_tpu.models import dcgan_image, dcgan_mnist, mlp_gan
+from gan_deeplearning4j_tpu.models import dcgan_image, dcgan_mnist, mlp_gan, wgan_gp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -24,12 +24,17 @@ class GanFamily:
     make_model_config: Callable  # ExperimentConfig-like -> family config
     build_discriminator: Callable
     build_generator: Callable
-    build_gan: Callable
-    sync_maps: Callable  # family config -> (DIS_TO_GAN, GAN_TO_GEN)
-    synthetic_data: Callable  # (num, family config, seed) -> (N, F) float32
+    # None for families with a bespoke loop (wgan_gp) — make_experiment then
+    # supplies the experiment class instead of the stacked-graph protocol
+    build_gan: Optional[Callable] = None
+    sync_maps: Optional[Callable] = None  # family config -> (DIS_TO_GAN, GAN_TO_GEN)
+    synthetic_data: Optional[Callable] = None  # (num, family config, seed) -> (N, F) f32
     # MNIST: the dis-feature transfer classifier (SURVEY I11); None elsewhere
     build_transfer_classifier: Optional[Callable] = None
     dis_to_cv: Optional[Dict[str, str]] = None
+    # custom experiment factory: (ExperimentConfig, mesh) -> experiment with
+    # the GanExperiment surface (train_iteration/run/save/load/exports)
+    make_experiment: Optional[Callable] = None
 
 
 def _mnist_config(cfg) -> dcgan_mnist.DcganConfig:
@@ -106,9 +111,29 @@ _FAMILIES: Dict[str, GanFamily] = {
             num, cfg, seed=seed
         ),
     ),
+    "wgan_gp": GanFamily(
+        name="wgan_gp",
+        make_model_config=lambda cfg: wgan_gp.WganGpConfig(
+            height=cfg.height, width=cfg.width, channels=cfg.channels,
+            z_size=cfg.z_size, seed=cfg.seed,
+            n_critic=cfg.n_critic, gp_lambda=cfg.gp_lambda,
+        ),
+        build_discriminator=wgan_gp.build_critic,
+        build_generator=wgan_gp.build_generator,
+        synthetic_data=lambda num, cfg, seed: dcgan_image.synthetic_images(
+            num, cfg, seed=seed
+        ),
+        make_experiment=lambda cfg, mesh: _wgan_experiment(cfg, mesh),
+    ),
 }
 # BASELINE.md config aliases
 _ALIASES = {"cifar10": "image", "celeba64": "image"}
+
+
+def _wgan_experiment(cfg, mesh):
+    from gan_deeplearning4j_tpu.harness.wgan_experiment import WganGpExperiment
+
+    return WganGpExperiment(cfg, mesh=mesh)
 
 
 def names() -> Tuple[str, ...]:

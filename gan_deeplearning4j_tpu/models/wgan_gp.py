@@ -34,7 +34,7 @@ from gan_deeplearning4j_tpu.nn import (
     InputType,
     OutputLayer,
 )
-from gan_deeplearning4j_tpu.optim import RmsProp
+from gan_deeplearning4j_tpu.optim import Adam
 from gan_deeplearning4j_tpu.optim.optimizer import GraphOptimizer
 from gan_deeplearning4j_tpu.ops import losses as loss_ops
 from gan_deeplearning4j_tpu.parallel.trainer import TrainState, make_train_state
@@ -50,6 +50,10 @@ class WganGpConfig:
     dense_width: int = 1024
     critic_learning_rate: float = 2e-4
     gen_learning_rate: float = 2e-4
+    # Adam(β1=0, β2=0.9) per Gulrajani et al. 2017 §5 — the BASELINE.json
+    # north star names Adam; WGAN-GP is the config that genuinely uses it
+    adam_beta1: float = 0.0
+    adam_beta2: float = 0.9
     gp_lambda: float = 10.0
     n_critic: int = 5
     seed: int = 666
@@ -66,6 +70,10 @@ class WganGpConfig:
         return stages_for(self.height, self.width)
 
 
+def _updater(cfg: WganGpConfig, lr: float) -> Adam:
+    return Adam(lr, cfg.adam_beta1, cfg.adam_beta2, 1e-8)
+
+
 def _graph_config(cfg: WganGpConfig, lr: float) -> GraphConfig:
     return GraphConfig(
         seed=cfg.seed,
@@ -74,14 +82,14 @@ def _graph_config(cfg: WganGpConfig, lr: float) -> GraphConfig:
         l2=0.0,
         gradient_clip=None if cfg.grad_clip <= 0 else "elementwise",
         gradient_clip_value=cfg.grad_clip,
-        updater=RmsProp(lr, 0.9, 1e-8),
+        updater=_updater(cfg, lr),
         optimization_algo="sgd",
     )
 
 
 def build_critic(cfg: WganGpConfig = WganGpConfig()) -> ComputationGraph:
     """Conv critic, NO BatchNorm, linear score head (loss='wasserstein')."""
-    up = RmsProp(cfg.critic_learning_rate, 0.9, 1e-8)
+    up = _updater(cfg, cfg.critic_learning_rate)
     b = GraphBuilder(_graph_config(cfg, cfg.critic_learning_rate))
     b.add_inputs("critic_input_0")
     b.set_input_types(InputType.convolutional_flat(cfg.height, cfg.width, cfg.channels))
@@ -108,7 +116,7 @@ def build_critic(cfg: WganGpConfig = WganGpConfig()) -> ComputationGraph:
 
 def build_generator(cfg: WganGpConfig = WganGpConfig()) -> ComputationGraph:
     """z → dense stem → deconv ×2 stages → sigmoid image, BN allowed here."""
-    up = RmsProp(cfg.gen_learning_rate, 0.9, 1e-8)
+    up = _updater(cfg, cfg.gen_learning_rate)
     stem_c = cfg.base_filters * (2 ** (cfg.stages - 1))
     b = GraphBuilder(_graph_config(cfg, cfg.gen_learning_rate))
     b.add_inputs("gen_input_0")

@@ -1,0 +1,151 @@
+"""WGAN-GP as a registry family (round-1 VERDICT weak #4): CLI config,
+checkpoint/resume, exports, and the experiment factory all treat BASELINE.md
+config 5 as a first-class run."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.data import ArrayDataSetIterator
+from gan_deeplearning4j_tpu.harness import ExperimentConfig, make_experiment
+from gan_deeplearning4j_tpu.harness.wgan_experiment import WganGpExperiment
+from gan_deeplearning4j_tpu.models import registry
+
+
+def tiny_config(tmp_path, **overrides) -> ExperimentConfig:
+    base = dict(
+        model_family="wgan_gp",
+        height=8, width=8, channels=1, num_features=64, z_size=4,
+        batch_size_train=8, batch_size_pred=8, n_critic=2,
+        num_iterations=1, latent_grid=2,
+        data_dir=str(tmp_path / "data"), output_dir=str(tmp_path / "out"),
+        save_models=False,
+    )
+    base.update(overrides)
+    return ExperimentConfig(**base)
+
+
+class TestRegistryCitizenship:
+    def test_family_registered(self):
+        fam = registry.get("wgan_gp")
+        assert fam.name == "wgan_gp" and fam.make_experiment is not None
+        assert "wgan_gp" in registry.names()
+
+    def test_factory_dispatch(self, tmp_path):
+        exp = make_experiment(tiny_config(tmp_path))
+        assert isinstance(exp, WganGpExperiment)
+        assert exp.cv is None
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):  # 10 % 3 != 0
+            ExperimentConfig(
+                model_family="wgan_gp", batch_size_train=10, n_critic=3,
+                height=8, width=8, channels=1, num_features=64,
+            ).validate()
+        with pytest.raises(ValueError):  # no param averaging for wgan
+            ExperimentConfig(
+                model_family="wgan_gp", distributed="param_averaging",
+                height=8, width=8, channels=1, num_features=64,
+                batch_size_train=10, n_critic=5,
+            ).validate()
+
+    def test_model_cfg_maps_knobs(self, tmp_path):
+        exp = make_experiment(tiny_config(tmp_path, gp_lambda=5.0, n_critic=4,
+                                          batch_size_train=8))
+        assert exp.model_cfg.gp_lambda == 5.0 and exp.model_cfg.n_critic == 4
+
+
+class TestWganExperimentLoop:
+    def test_run_end_to_end(self, tmp_path):
+        cfg = tiny_config(tmp_path, save_models=True)
+        exp = make_experiment(cfg)
+        fam = registry.get("wgan_gp")
+        feats = fam.synthetic_data(16, exp.model_cfg, 0)
+        labels = np.eye(10, dtype=np.float32)[np.arange(16) % 10]
+        train = ArrayDataSetIterator(feats, labels, batch_size=8)
+        result = exp.run(train)
+        assert result["iterations"] == 1
+        h = result["history"][0]
+        assert np.isfinite(h["d_loss"]) and np.isfinite(h["g_loss"])
+        assert np.isnan(h["cv_loss"])  # no transfer classifier
+        manifold = np.loadtxt(
+            os.path.join(cfg.output_dir, "mnist_out_1.csv"), delimiter=","
+        )
+        assert manifold.shape == (4, 64)
+        assert manifold.min() >= 0.0 and manifold.max() <= 1.0  # sigmoid image
+        for name in ("critic", "gen"):
+            assert os.path.exists(
+                os.path.join(cfg.output_dir, f"mnist_{name}_model.zip")
+            )
+
+    def test_checkpoint_resume_roundtrip(self, tmp_path):
+        import jax
+
+        cfg = tiny_config(tmp_path, save_models=True)
+        exp = make_experiment(cfg)
+        fam = registry.get("wgan_gp")
+        feats = fam.synthetic_data(8, exp.model_cfg, 0)
+        exp.train_iteration(feats)
+        exp.save_models()
+
+        exp2 = make_experiment(cfg)
+        restored = exp2.load_models()
+        assert restored == int(exp.gen_state.step)
+        jax.tree_util.tree_map(
+            lambda u, v: np.testing.assert_array_equal(np.asarray(u), np.asarray(v)),
+            exp.critic_state.params, exp2.critic_state.params,
+        )
+        jax.tree_util.tree_map(
+            lambda u, v: np.testing.assert_array_equal(np.asarray(u), np.asarray(v)),
+            exp.critic_state.opt_state, exp2.critic_state.opt_state,
+        )
+        # resumed training proceeds
+        losses = exp2.train_iteration(feats)
+        assert np.isfinite(float(losses["d_loss"]))
+        assert int(exp2.gen_state.step) == restored + 1
+
+    def test_ragged_tail_batches_survive(self, tmp_path):
+        """Epoch tails: an indivisible batch truncates to a full critic
+        round; a batch smaller than n_critic pads by cycling — either way
+        the run continues instead of aborting (code-review r2 finding)."""
+        exp = make_experiment(tiny_config(tmp_path))  # n_critic=2
+        fam = registry.get("wgan_gp")
+        feats7 = fam.synthetic_data(7, exp.model_cfg, 0)
+        losses = exp.train_iteration(feats7)  # 7 -> truncated to 6
+        assert np.isfinite(float(losses["d_loss"]))
+        feats1 = fam.synthetic_data(1, exp.model_cfg, 1)
+        losses = exp.train_iteration(feats1)  # 1 -> padded to 2
+        assert np.isfinite(float(losses["d_loss"]))
+        with pytest.raises(ValueError):
+            exp.train_iteration(np.zeros((0, 64), np.float32))
+
+    def test_predictions_refused(self, tmp_path):
+        exp = make_experiment(tiny_config(tmp_path))
+        with pytest.raises(ValueError):
+            exp.export_predictions(None, 1)
+
+    def test_sample_shape(self, tmp_path):
+        exp = make_experiment(tiny_config(tmp_path))
+        imgs = exp.sample(4)
+        assert imgs.shape == (4, 8, 8, 1)
+
+
+class TestWganCli:
+    def test_main_wgan_family(self, tmp_path, capsys):
+        from gan_deeplearning4j_tpu.__main__ import main
+
+        rc = main([
+            "--model-family", "wgan_gp",
+            "--height", "8", "--width", "8", "--channels", "1",
+            "--num-features", "64", "--z-size", "4",
+            "--batch-size-train", "8", "--batch-size-pred", "8",
+            "--n-critic", "2", "--num-iterations", "1", "--latent-grid", "2",
+            "--data-dir", str(tmp_path / "data"),
+            "--output-dir", str(tmp_path / "out"),
+            "--save-models", "false",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Manifold image:" in out  # PNG rendered without a classifier
+        assert (tmp_path / "out" / "DCGAN_Generated_Images.png").exists()
