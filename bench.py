@@ -2,51 +2,161 @@
 
 Default (what the driver runs): config 1 — DCGAN-MNIST alternating-loop
 throughput at batch 64 (the reference topology,
-dl4jGANComputerVision.java:117-314) — printed as ONE JSON line.
+dl4jGANComputerVision.java:117-314) — printed as ONE JSON line carrying
+images/sec, MFU, and the bf16-vs-f32 delta.
 
 ``--config N|all`` runs the other configs (tabular MLP-GAN, CIFAR-10 DCGAN,
 CelebA-64 data-parallel, WGAN-GP); ``--json benchmarks.json`` also writes the
-full result list. The reference publishes no numbers (BASELINE.md), so these
-runs *establish* the baseline; vs_baseline reports against the recorded
-targets below once they exist."""
+full result list; ``--update-baselines`` persists measured values into
+``BENCH_BASELINES.json`` so later rounds report honest ``vs_baseline`` ratios.
+
+Backend bring-up is hardened against the round-1 failure (the TPU PJRT
+plugin hanging or erroring at init): the backend is first probed in a
+SUBPROCESS with a timeout, retried with backoff, and on exhaustion the bench
+falls back to CPU with every result line marked ``"degraded": true`` and the
+probe log attached — a dead chip yields labeled data + diagnostics instead
+of rc=1 and nothing (VERDICT r1 weak #1).
+"""
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-# First recorded real-TPU numbers per config become the baselines to beat.
-BASELINES = {
-    "dcgan_mnist_images_per_sec_per_chip": None,
-    "tabular_mlp_gan_rows_per_sec_per_chip": None,
-    "dcgan_cifar10_images_per_sec_per_chip": None,
-    "dcgan_celeba64_dp_images_per_sec": None,
-    "wgan_gp_cifar10_images_per_sec_per_chip": None,
-}
+_REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINES_FILE = os.path.join(_REPO, "BENCH_BASELINES.json")
 
 WARMUP_ITERS = 3
 TIMED_ITERS = 20
 
+# Peak dense-matmul throughput per chip, bf16 (the MFU denominator; MFU is
+# reported against the bf16 peak for BOTH compute dtypes — a consistent,
+# conservative convention, since f32 work still occupies the same MXU).
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5", 197e12),  # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+]
 
-def _bench_experiment(family: str, batch: int, *, height=28, width=28, channels=1,
-                      num_features=None, z_size=2, distributed="none", mesh=None):
-    """Throughput of the full alternating iteration for one GAN family."""
+
+def load_baselines() -> dict:
+    """Per-metric baselines recorded by a previous round (``None``/absent →
+    no baseline yet; vs_baseline is then null, not a fake 1.0)."""
+    try:
+        with open(BASELINES_FILE) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def _peak_flops(device_kind: str):
+    kind = (device_kind or "").lower()
+    for key, peak in PEAK_FLOPS_BY_KIND:
+        if key in kind:
+            return peak
+    return None
+
+
+# ---------------------------------------------------------------------------
+# backend bring-up (VERDICT r1 weak #1: survive a flaky/hanging TPU init)
+# ---------------------------------------------------------------------------
+
+def probe_backend(timeout: float) -> dict:
+    """Try backend init in a subprocess — a hang or crash there cannot take
+    the bench process down with it."""
+    code = (
+        "import jax,json;d=jax.devices();"
+        "print(json.dumps({'platform':jax.default_backend(),"
+        "'n':len(d),'kind':d[0].device_kind}))"
+    )
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "ok": False, "seconds": round(time.time() - t0, 1),
+            "error": f"backend init exceeded {timeout}s (hang)",
+        }
+    out = {"ok": r.returncode == 0, "seconds": round(time.time() - t0, 1)}
+    if r.returncode == 0:
+        try:
+            out.update(json.loads(r.stdout.strip().splitlines()[-1]))
+        except (ValueError, IndexError):
+            out["ok"] = False
+            out["error"] = f"unparseable probe output: {r.stdout[-300:]!r}"
+    else:
+        out["error"] = (r.stderr or r.stdout)[-500:]
+    return out
+
+
+def bring_up_backend(retries: int, probe_timeout: float, backoff: float) -> dict:
+    """Probe with bounded retry/backoff; fall back to CPU when the
+    accelerator never comes up. Returns the diagnostics dict; after this the
+    in-process jax platform is pinned accordingly."""
+    diag = {
+        "attempts": [],
+        "env": {
+            k: os.environ.get(k)
+            for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PJRT_DEVICE", "TPU_NAME")
+            if os.environ.get(k) is not None
+        },
+    }
+    for i in range(retries):
+        p = probe_backend(probe_timeout)
+        diag["attempts"].append(p)
+        print(f"# backend probe {i + 1}/{retries}: {p}", file=sys.stderr)
+        if p.get("ok") and p.get("platform") != "cpu":
+            diag.update(platform=p["platform"], device_kind=p.get("kind"),
+                        devices=p.get("n"), degraded=False)
+            return diag
+        if p.get("ok") and p.get("platform") == "cpu":
+            # deliberate CPU pin (e.g. JAX_PLATFORMS=cpu): deterministic —
+            # re-probing with backoff cannot change it, skip straight to the
+            # CPU path (still marked degraded: baselines are TPU numbers)
+            break
+        if i + 1 < retries:
+            time.sleep(backoff * (i + 1))
+    # accelerator unavailable — measure on CPU but say so loudly
     import jax
 
-    from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
-    from gan_deeplearning4j_tpu.harness.experiment import GanExperiment
+    jax.config.update("jax_platforms", "cpu")
+    diag.update(platform="cpu", device_kind="cpu", devices=None, degraded=True)
+    return diag
+
+
+# ---------------------------------------------------------------------------
+# the five configs
+# ---------------------------------------------------------------------------
+
+def _bench_experiment(family: str, batch: int, *, height=28, width=28, channels=1,
+                      num_features=None, z_size=2, distributed="none", mesh=None,
+                      compute_dtype=None, n_critic=5):
+    """Throughput + FLOPs of the full alternating iteration for one family.
+    Every family (wgan_gp included) goes through the same harness factory."""
+    import jax
+
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig, make_experiment
 
     num_features = num_features or height * width * channels
     cfg = ExperimentConfig(
         model_family=family, batch_size_train=batch, batch_size_pred=batch,
         height=height, width=width, channels=channels, num_features=num_features,
         z_size=z_size, num_iterations=WARMUP_ITERS + TIMED_ITERS,
-        save_models=False, distributed=distributed,
+        save_models=False, distributed=distributed, compute_dtype=compute_dtype,
+        n_critic=n_critic,
     )
-    exp = GanExperiment(cfg, mesh=mesh)
+    exp = make_experiment(cfg, mesh=mesh)
     rng = np.random.default_rng(0)
     feats = exp.family.synthetic_data(batch, exp.model_cfg, 0)[:batch]
     labels = np.eye(cfg.num_classes, dtype=np.float32)[
@@ -59,83 +169,91 @@ def _bench_experiment(family: str, batch: int, *, height=28, width=28, channels=
     for _ in range(TIMED_ITERS):
         losses = exp.train_iteration(feats, labels)
     jax.block_until_ready(losses)
-    return TIMED_ITERS * batch / (time.perf_counter() - t0)
-
-
-def bench_mnist():
+    elapsed = time.perf_counter() - t0
+    try:
+        flops = exp.flops_per_iteration(batch)
+    except Exception as exc:  # cost model must never sink the measurement
+        print(f"# cost analysis failed: {type(exc).__name__}: {exc}", file=sys.stderr)
+        flops = None
     return {
-        "metric": "dcgan_mnist_images_per_sec_per_chip",
-        "value": _bench_experiment("mnist", 64),
-        "unit": "images/sec",
+        "items_per_sec": TIMED_ITERS * batch / elapsed,
+        "sec_per_iter": elapsed / TIMED_ITERS,
+        "flops_per_iter": flops,
     }
 
 
-def bench_tabular():
+def _with_mfu(measure: dict, diag: dict) -> dict:
+    peak = _peak_flops(diag.get("device_kind"))
+    mfu = None
+    if peak and measure["flops_per_iter"]:
+        mfu = measure["flops_per_iter"] / (measure["sec_per_iter"] * peak)
     return {
-        "metric": "tabular_mlp_gan_rows_per_sec_per_chip",
-        "value": _bench_experiment(
-            "tabular", 256, num_features=32, z_size=8, height=1, width=1, channels=1
-        ),
-        "unit": "rows/sec",
+        "value": measure["items_per_sec"],
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_iter": measure["flops_per_iter"],
     }
 
 
-def bench_cifar10():
-    return {
-        "metric": "dcgan_cifar10_images_per_sec_per_chip",
-        "value": _bench_experiment(
-            "cifar10", 64, height=32, width=32, channels=3, z_size=64
-        ),
-        "unit": "images/sec",
-    }
+def bench_mnist(diag):
+    """Config 1 + the bf16-vs-f32 delta (VERDICT r1 item 4). Headline value
+    is the faster precision (bf16 on the MXU; f32 can win on the degraded
+    CPU path, which has no bf16 units) — both numbers are reported."""
+    bf16 = _bench_experiment("mnist", 64, compute_dtype="bf16")
+    f32 = _bench_experiment("mnist", 64, compute_dtype=None)
+    best, dtype = (bf16, "bf16") if bf16["items_per_sec"] >= f32["items_per_sec"] \
+        else (f32, "f32")
+    out = {"metric": "dcgan_mnist_images_per_sec_per_chip", "unit": "images/sec",
+           "compute_dtype": dtype, **_with_mfu(best, diag)}
+    out["f32_images_per_sec"] = round(f32["items_per_sec"], 2)
+    out["bf16_images_per_sec"] = round(bf16["items_per_sec"], 2)
+    out["bf16_speedup_vs_f32"] = round(
+        bf16["items_per_sec"] / f32["items_per_sec"], 3
+    )
+    return out
 
 
-def bench_celeba64():
+def bench_tabular(diag):
+    m = _bench_experiment(
+        "tabular", 256, num_features=32, z_size=8, height=1, width=1, channels=1,
+        compute_dtype="bf16",
+    )
+    return {"metric": "tabular_mlp_gan_rows_per_sec_per_chip", "unit": "rows/sec",
+            "compute_dtype": "bf16", **_with_mfu(m, diag)}
+
+
+def bench_cifar10(diag):
+    m = _bench_experiment(
+        "cifar10", 64, height=32, width=32, channels=3, z_size=64,
+        compute_dtype="bf16",
+    )
+    return {"metric": "dcgan_cifar10_images_per_sec_per_chip", "unit": "images/sec",
+            "compute_dtype": "bf16", **_with_mfu(m, diag)}
+
+
+def bench_celeba64(diag):
     """Data-parallel over all visible devices (v5e-8 in the target rig; on a
     single chip this degenerates to a 1-device mesh — still the DP code path)."""
     from gan_deeplearning4j_tpu.runtime import TpuEnvironment
 
     mesh = TpuEnvironment().make_mesh()
     n = mesh.devices.size
-    return {
-        "metric": "dcgan_celeba64_dp_images_per_sec",
-        "value": _bench_experiment(
-            "celeba64", 8 * n, height=64, width=64, channels=3, z_size=64,
-            distributed="pmean", mesh=mesh,
-        ),
-        "unit": "images/sec",
-        "devices": n,
-    }
+    m = _bench_experiment(
+        "celeba64", 8 * n, height=64, width=64, channels=3, z_size=64,
+        distributed="pmean", mesh=mesh, compute_dtype="bf16",
+    )
+    return {"metric": "dcgan_celeba64_dp_images_per_sec", "unit": "images/sec",
+            "compute_dtype": "bf16", "devices": n, **_with_mfu(m, diag)}
 
 
-def bench_wgan_gp():
-    import jax
-
-    from gan_deeplearning4j_tpu.models import wgan_gp
-
-    cfg = wgan_gp.WganGpConfig()
-    tr = wgan_gp.WganGpTrainer(cfg)
-    critic_state, gen_state = tr.init_states(seed=0)
-    batch = 64
-    rng = np.random.default_rng(0)
-    real = rng.random((cfg.n_critic, batch, cfg.num_features), dtype=np.float32)
-    key = jax.random.PRNGKey(0)
-    for _ in range(WARMUP_ITERS):
-        key, sub = jax.random.split(key)
-        critic_state, gen_state, c_loss, _ = tr.train_round(critic_state, gen_state, real, sub)
-    jax.block_until_ready(c_loss)
-    t0 = time.perf_counter()
-    for _ in range(TIMED_ITERS):
-        key, sub = jax.random.split(key)
-        critic_state, gen_state, c_loss, _ = tr.train_round(critic_state, gen_state, real, sub)
-    jax.block_until_ready(c_loss)
-    # images/sec counts every critic batch + the generator batch
-    per_round = (cfg.n_critic + 1) * batch
-    return {
-        "metric": "wgan_gp_cifar10_images_per_sec_per_chip",
-        "value": TIMED_ITERS * per_round / (time.perf_counter() - t0),
-        "unit": "images/sec",
-    }
+def bench_wgan_gp(diag):
+    """Config 5 through the same harness (registry family since round 2).
+    320 = 5 critic minibatches of 64; value counts real images consumed."""
+    m = _bench_experiment(
+        "wgan_gp", 320, height=32, width=32, channels=3, num_features=3072,
+        z_size=128, compute_dtype="bf16", n_critic=5,
+    )
+    return {"metric": "wgan_gp_cifar10_images_per_sec_per_chip", "unit": "images/sec",
+            "compute_dtype": "bf16", **_with_mfu(m, diag)}
 
 
 CONFIGS = {
@@ -152,26 +270,53 @@ def main() -> None:
     p.add_argument("--config", default="1", choices=[*CONFIGS, "all"],
                    help="BASELINE config number (default 1: DCGAN MNIST)")
     p.add_argument("--json", default=None, help="also write full results here")
+    p.add_argument("--update-baselines", action="store_true",
+                   help=f"record measured values into {os.path.basename(BASELINES_FILE)}")
+    p.add_argument("--retries", type=int, default=3,
+                   help="backend probe attempts before CPU fallback")
+    p.add_argument("--probe-timeout", type=float, default=150.0,
+                   help="seconds per backend-init probe")
+    p.add_argument("--backoff", type=float, default=30.0,
+                   help="base seconds between probe attempts")
     args = p.parse_args()
+
+    diag = bring_up_backend(args.retries, args.probe_timeout, args.backoff)
+    baselines = load_baselines()
 
     keys = list(CONFIGS) if args.config == "all" else [args.config]
     results = []
     failed = False
     for k in keys:
         try:
-            r = CONFIGS[k]()
+            r = CONFIGS[k](diag)
         except Exception as exc:  # keep earlier (expensive) results on failure
-            print(json.dumps({"config": k, "error": f"{type(exc).__name__}: {exc}"}))
+            r = {"config": k, "error": f"{type(exc).__name__}: {exc}"}
             failed = True
-            continue
-        base = BASELINES.get(r["metric"])
-        r["value"] = round(float(r["value"]), 2)
-        r["vs_baseline"] = round(r["value"] / base, 3) if base else 1.0
+        else:
+            r["value"] = round(float(r["value"]), 2)
+            base = baselines.get(r["metric"])
+            # null when no baseline exists or the run is degraded-CPU (a CPU
+            # number against a TPU baseline would be meaningless)
+            r["vs_baseline"] = (
+                round(r["value"] / base, 3) if base and not diag["degraded"] else None
+            )
+        r["platform"] = diag["platform"]
+        r["device_kind"] = diag.get("device_kind")
+        r["degraded"] = diag["degraded"]
         results.append(r)
         print(json.dumps(r))
-        if args.json:  # flush after every config, not only at the end
+        if args.json:  # flush after every config (errors included), not
+            # only at the end — a trailing failure must not lose the file
             with open(args.json, "w") as fh:
-                json.dump(results, fh, indent=2)
+                json.dump({"diagnostics": diag, "results": results}, fh, indent=2)
+    if args.update_baselines and not diag["degraded"]:
+        merged = dict(baselines)
+        merged.update({
+            r["metric"]: r["value"] for r in results if "metric" in r
+        })
+        with open(BASELINES_FILE, "w") as fh:
+            json.dump(merged, fh, indent=2)
+        print(f"# baselines updated: {BASELINES_FILE}", file=sys.stderr)
     if failed:
         raise SystemExit(1)
 
