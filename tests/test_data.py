@@ -140,3 +140,101 @@ def test_stratified_sample_and_prepare(tmp_path):
     xt, yt = load_mnist_csv(train_p)
     assert xt.shape == (60, 784) and yt.shape == (60,)
     assert (tmp_path / "sampled_mnist_train.csv").exists()
+
+
+class TestIdxAndRealDigits:
+    """Round-2 VERDICT item 7: IDX support + real-data-over-synthetic."""
+
+    def _write_idx(self, path, arr):
+        import struct
+
+        codes = {np.dtype(np.uint8): 0x08, np.dtype(">i4"): 0x0C}
+        with open(path, "wb") as fh:
+            fh.write(bytes([0, 0, codes[arr.dtype], arr.ndim]))
+            for d in arr.shape:
+                fh.write(struct.pack(">i", d))
+            fh.write(arr.tobytes())
+
+    def test_idx_roundtrip(self, tmp_path):
+        from gan_deeplearning4j_tpu.data.mnist import read_idx
+
+        arr = np.arange(2 * 5 * 4, dtype=np.uint8).reshape(2, 5, 4)
+        p = str(tmp_path / "x-idx3-ubyte")
+        self._write_idx(p, arr)
+        np.testing.assert_array_equal(read_idx(p), arr)
+
+    def test_idx_gzip_and_errors(self, tmp_path):
+        import gzip
+
+        from gan_deeplearning4j_tpu.data.mnist import read_idx
+
+        arr = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        raw_path = str(tmp_path / "y-idx2-ubyte")
+        self._write_idx(raw_path, arr)
+        gz_path = raw_path + ".gz"
+        with open(raw_path, "rb") as src, gzip.open(gz_path, "wb") as dst:
+            dst.write(src.read())
+        np.testing.assert_array_equal(read_idx(gz_path), arr)
+        bad = str(tmp_path / "bad")
+        with open(bad, "wb") as fh:
+            fh.write(b"\x01\x02\x03\x04")
+        with pytest.raises(ValueError):
+            read_idx(bad)
+
+    def test_load_mnist_idx_directory(self, tmp_path):
+        from gan_deeplearning4j_tpu.data.mnist import load_mnist_idx
+
+        rng = np.random.default_rng(0)
+        tr_img = rng.integers(0, 256, size=(6, 28, 28)).astype(np.uint8)
+        te_img = rng.integers(0, 256, size=(3, 28, 28)).astype(np.uint8)
+        tr_lab = (np.arange(6) % 10).astype(np.uint8)
+        te_lab = (np.arange(3) % 10).astype(np.uint8)
+        names = {
+            "train-images-idx3-ubyte": tr_img,
+            "train-labels-idx1-ubyte": tr_lab,
+            "t10k-images-idx3-ubyte": te_img,
+            "t10k-labels-idx1-ubyte": te_lab,
+        }
+        for name, arr in names.items():
+            self._write_idx(str(tmp_path / name), arr)
+        (xtr, ytr), (xte, yte) = load_mnist_idx(str(tmp_path))
+        assert xtr.shape == (6, 784) and xte.shape == (3, 784)
+        assert xtr.dtype == np.float32 and 0.0 <= xtr.min() and xtr.max() <= 1.0
+        np.testing.assert_array_equal(ytr, tr_lab)
+
+    def test_find_mnist_idx_env(self, tmp_path, monkeypatch):
+        from gan_deeplearning4j_tpu.data.mnist import find_mnist_idx
+
+        monkeypatch.setenv("MNIST_DIR", str(tmp_path))
+        assert find_mnist_idx() is None  # incomplete dir is not a hit
+        rng = np.random.default_rng(0)
+        for name, shape, code in (
+            ("train-images-idx3-ubyte", (2, 28, 28), None),
+            ("train-labels-idx1-ubyte", (2,), None),
+            ("t10k-images-idx3-ubyte", (2, 28, 28), None),
+            ("t10k-labels-idx1-ubyte", (2,), None),
+        ):
+            self._write_idx(
+                str(tmp_path / name),
+                rng.integers(0, 10, size=shape).astype(np.uint8),
+            )
+        assert find_mnist_idx() == str(tmp_path)
+
+    def test_real_digits_shapes(self):
+        from gan_deeplearning4j_tpu.data.mnist import real_digits
+
+        (xtr, ytr), (xte, yte) = real_digits(num_train=2500, num_test=100)
+        assert xtr.shape == (2500, 784) and xte.shape == (100, 784)
+        assert xtr.dtype == np.float32
+        assert 0.0 <= xtr.min() and xtr.max() <= 1.0
+        assert set(np.unique(ytr)) <= set(range(10))
+        # real data: every class present at this sample size
+        assert len(np.unique(ytr)) == 10
+
+    def test_load_mnist_prefers_real(self):
+        from gan_deeplearning4j_tpu.data.mnist import load_mnist
+
+        tag, ((xtr, ytr), _) = load_mnist(num_train=50, num_test=10)
+        # this image has sklearn but no IDX MNIST → the real UCI digits win
+        assert tag == "uci-digits-upsampled"
+        assert xtr.shape == (50, 784)
