@@ -43,7 +43,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
             ctypes.c_long, ctypes.c_long, ctypes.c_char, ctypes.c_int,
         ]
-    except OSError:
+        lib.gdt_csv_write_f64.restype = ctypes.c_int
+        lib.gdt_csv_write_f64.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long, ctypes.c_long, ctypes.c_char, ctypes.c_int,
+        ]
+    except (OSError, AttributeError):
+        # AttributeError: a stale prebuilt .so missing a newer symbol (e.g.
+        # gdt_csv_write_f64) — fall back to numpy rather than crash callers
         _load_failed = True
         return None
     _lib = lib
@@ -87,16 +94,24 @@ def load_csv(path: str, skip_lines: int = 0, delimiter: str = ",") -> np.ndarray
 
 
 def write_csv(path: str, array: np.ndarray, delimiter: str = ",", precision: int = 6) -> str:
-    """Write an (N, C) array as CSV (%.{precision}f) via the native lib."""
+    """Write an (N, C) array as CSV (%.{precision}f) via the native lib.
+    float64 input formats from float64 (matching the numpy fallback digit
+    for digit); everything else goes through float32."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native CSV library unavailable")
-    arr = np.ascontiguousarray(np.asarray(array, dtype=np.float32))
+    array = np.asarray(array)
+    if array.dtype == np.float64:
+        arr = np.ascontiguousarray(array)
+        fn, ctype = lib.gdt_csv_write_f64, ctypes.c_double
+    else:
+        arr = np.ascontiguousarray(array.astype(np.float32, copy=False))
+        fn, ctype = lib.gdt_csv_write, ctypes.c_float
     if arr.ndim != 2:
         raise ValueError(f"expected a 2-D array, got shape {arr.shape}")
-    status = lib.gdt_csv_write(
+    status = fn(
         os.fspath(path).encode(),
-        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        arr.ctypes.data_as(ctypes.POINTER(ctype)),
         arr.shape[0], arr.shape[1], delimiter.encode()[:1], precision,
     )
     if status != 0:

@@ -16,6 +16,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <string>
 #include <thread>
 #include <vector>
@@ -118,17 +119,34 @@ const char* parse_float_fast(const char* p, const char* end, float* out) {
   return p;
 }
 
+// Process-lifetime "C" locale so the strtof fallback is deterministic under
+// any LC_NUMERIC (a comma-decimal locale would otherwise parse "3.14" as 3).
+locale_t c_locale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", static_cast<locale_t>(0));
+  return loc;
+}
+
 // Parse one line of `cols` floats into out; returns false on error.
 bool parse_line(const char* p, const char* end, char delim, long cols, float* out) {
   for (long c = 0; c < cols; ++c) {
     while (p < end && *p == ' ') ++p;
     const char* next = parse_float_fast(p, end, &out[c]);
     if (next == nullptr) {  // rare shapes (nan/inf/huge) -> strtof fallback
+      // Bounded: copy the field into a NUL-terminated scratch buffer first —
+      // strtof on the raw pointer would scan the whole null-terminated file
+      // buffer past the logical field end.
+      const char* fend = p;
+      while (fend < end && *fend != delim) ++fend;
+      char scratch[64];
+      const size_t len = static_cast<size_t>(fend - p);
+      if (len == 0 || len >= sizeof(scratch)) return false;
+      std::memcpy(scratch, p, len);
+      scratch[len] = '\0';
       char* sn = nullptr;
       errno = 0;
-      out[c] = std::strtof(p, &sn);
-      if (sn == p) return false;
-      next = sn;
+      out[c] = strtof_l(scratch, &sn, c_locale());
+      if (sn == scratch) return false;
+      next = p + (sn - scratch);
     }
     p = next;
     while (p < end && *p == ' ') ++p;
@@ -199,6 +217,8 @@ int gdt_csv_read(const char* path, long skip_lines, char delim, float** out_data
 
 void gdt_csv_free(float* ptr) { std::free(ptr); }
 
+}  // extern "C"
+
 namespace {
 
 // Fixed-precision float -> decimal text, round-half-away-from-zero (printf
@@ -244,12 +264,12 @@ inline char* emit_fixed(char* out, double v, int precision) {
   return out;
 }
 
-}  // namespace
-
-// Write a dense float32 matrix as fixed-precision CSV (the export path,
-// reference :550-598, without per-scalar host reads). Returns 0 on success.
-int gdt_csv_write(const char* path, const float* data, long rows, long cols,
-                  char delim, int precision) {
+// Shared writer body over the element type: f32 exports come straight from
+// device fetches; f64 exists so the native path formats the same digits as
+// the numpy fallback for double input (no silent downcast).
+template <typename T>
+int write_csv_impl(const char* path, const T* data, long rows, long cols,
+                   char delim, int precision) {
   std::FILE* f = std::fopen(path, "wb");
   if (!f) return 1;
   if (precision < 0 || precision > 17) precision = 6;
@@ -276,6 +296,24 @@ int gdt_csv_write(const char* path, const float* data, long rows, long cols,
     }
   }
   return std::fclose(f) == 0 ? 0 : 1;  // flush failure = write failure
+}
+
+}  // namespace
+
+extern "C" {
+
+// Write a dense float32 matrix as fixed-precision CSV (the export path,
+// reference :550-598, without per-scalar host reads). Returns 0 on success.
+int gdt_csv_write(const char* path, const float* data, long rows, long cols,
+                  char delim, int precision) {
+  return write_csv_impl(path, data, rows, cols, delim, precision);
+}
+
+// Same, formatting from float64 (keeps the native writer digit-identical to
+// the numpy fallback when callers hand in doubles).
+int gdt_csv_write_f64(const char* path, const double* data, long rows,
+                      long cols, char delim, int precision) {
+  return write_csv_impl(path, data, rows, cols, delim, precision);
 }
 
 }  // extern "C"

@@ -239,7 +239,16 @@ class ParameterAveragingTrainer:
                     state, rng, feats, labs, freq, self.batch_size_per_worker
                 )
                 feats, labs, n = feats[used:], labs[used:], n - used
-            # ragged tail: shrink the per-worker batch and pad by cycling
+            # Ragged tail: shrink the per-worker batch and pad by cycling
+            # rows. Weighting note: the < num_workers padded rows are trained
+            # twice at full weight in this final partial round — a bounded
+            # skew analogous to DL4J's uneven worker splits (the reference's
+            # TrainingMaster repartitions without per-row weighting either).
+            # Masking inside the scanned program would buy exactness at the
+            # cost of a second compiled round shape; with duplication bounded
+            # by num_workers-1 rows out of >= num_workers, the skew is < one
+            # worker-batch in 10^3 at reference scale — documented, not
+            # corrected.
             if n > 0:
                 b = max(1, -(-n // self.num_workers))  # ceil
                 need = self.num_workers * b
