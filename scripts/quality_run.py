@@ -1,0 +1,153 @@
+"""Long training run + quality eval (round-1 VERDICT item 3).
+
+Trains the MNIST-family DCGAN + transfer classifier on the best available
+real data (see ``data/mnist.py::load_mnist`` — on this image: the bundled UCI
+handwritten digits upsampled to 28×28), then records the quality artifacts
+the reference implies (gan.ipynb cells 5–6 + ``DCGAN_Generated_Images.png``):
+
+- the 10×10 latent-manifold PNG (committed into ``artifacts/``),
+- transfer-classifier accuracy on the held-out test split,
+- FID@50k: 50k generator samples vs the real set, features tapped from the
+  trained discriminator's ``dis_dense_layer_6`` (the layer the reference's
+  transfer classifier trusts; no Inception weights exist offline —
+  BASELINE.md "Data provenance"),
+- per-iteration throughput stats.
+
+Writes ``artifacts/quality_run.json`` + the PNG; run with ``--cpu`` to force
+the host backend when no TPU is reachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iterations", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=200)
+    ap.add_argument("--num-train", type=int, default=10000)
+    ap.add_argument("--num-test", type=int, default=1000)
+    ap.add_argument("--fid-samples", type=int, default=50000)
+    ap.add_argument("--out", default="artifacts")
+    ap.add_argument("--export-every", type=int, default=50)
+    ap.add_argument("--compute-dtype", default=None)
+    ap.add_argument("--cpu", action="store_true", help="force the host backend")
+    ap.add_argument("--seed", type=int, default=666)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from gan_deeplearning4j_tpu.data import ArrayDataSetIterator
+    from gan_deeplearning4j_tpu.data.dataset import one_hot_np
+    from gan_deeplearning4j_tpu.data.mnist import load_mnist, write_mnist_csv
+    from gan_deeplearning4j_tpu.eval import render_manifold
+    from gan_deeplearning4j_tpu.eval.accuracy import accuracy_score
+    from gan_deeplearning4j_tpu.eval.fid import fid_score, graph_feature_fn
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+
+    t_start = time.time()
+    os.makedirs(args.out, exist_ok=True)
+    tag, ((xtr, ytr), (xte, yte)) = load_mnist(
+        num_train=args.num_train, num_test=args.num_test, seed=args.seed
+    )
+    print(f"data source: {tag}  train={xtr.shape}  test={xte.shape}", flush=True)
+
+    cfg = ExperimentConfig(
+        batch_size_train=args.batch,
+        batch_size_pred=500,
+        num_iterations=args.iterations,
+        print_every=args.export_every,
+        save_every=args.export_every,
+        save_models=False,  # checkpoint once at the end, not per iteration
+        output_dir=args.out,
+        compute_dtype=args.compute_dtype,
+        seed=args.seed,
+    )
+    exp = GanExperiment(cfg)
+    train_it = ArrayDataSetIterator(xtr, one_hot_np(ytr, 10), batch_size=args.batch)
+    test_it = ArrayDataSetIterator(xte, one_hot_np(yte, 10), batch_size=500)
+    # the accuracy CSV contract needs the test file on disk
+    test_csv = os.path.join(args.out, "quality_test.csv")
+    write_mnist_csv(test_csv, xte, yte)
+
+    result = exp.run(train_it, test_it)
+    ips = [h["images_per_sec"] for h in result["history"]]
+    print(
+        f"trained {result['iterations']} iterations; "
+        f"median {np.median(ips):.1f} images/sec",
+        flush=True,
+    )
+    exp.save_models()
+
+    # manifold PNG (the DCGAN_Generated_Images.png artifact)
+    manifold_csv = exp.export_manifold(result["iterations"])
+    png = render_manifold(
+        manifold_csv,
+        os.path.join(args.out, "DCGAN_Generated_Images.png"),
+        grid=cfg.latent_grid, side=cfg.height, channels=cfg.channels,
+    )
+    print(f"manifold: {png}", flush=True)
+
+    # accuracy (cell-6 flow, in-process)
+    preds_csv = exp.export_predictions(test_it, result["iterations"])
+    preds = np.loadtxt(preds_csv, delimiter=",", ndmin=2)
+    acc = accuracy_score(preds, yte)
+    print(f"transfer-classifier accuracy: {acc * 100:.2f}%", flush=True)
+
+    # FID@50k: generator samples vs the real training set, dis features
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(args.seed + 7)
+    fakes = []
+    bs = 1000
+    t0 = time.time()
+    for i in range(0, args.fid_samples, bs):
+        n = min(bs, args.fid_samples - i)
+        z = rng.random((n, cfg.z_size), dtype=np.float32) * 2.0 - 1.0
+        out = exp._gen_fwd(exp.gen_params, jnp.asarray(z))
+        fakes.append(np.asarray(out).reshape(n, cfg.num_features))
+    fakes = np.concatenate(fakes, axis=0)
+    feature_fn = graph_feature_fn(
+        exp.dis, exp.dis_state.params, "dis_dense_layer_6", batch_size=500
+    )
+    fid = fid_score(xtr, fakes, feature_fn)
+    print(f"FID@{args.fid_samples // 1000}k (dis features): {fid:.2f} "
+          f"({time.time() - t0:.0f}s)", flush=True)
+
+    report = {
+        "data_source": tag,
+        "iterations": result["iterations"],
+        "batch_size": args.batch,
+        "compute_dtype": args.compute_dtype or "f32",
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "accuracy": round(float(acc), 4),
+        "fid_at": args.fid_samples,
+        "fid_dis_features": round(float(fid), 3),
+        "images_per_sec_median": round(float(np.median(ips)), 2),
+        "d_loss_final": result["history"][-1]["d_loss"],
+        "g_loss_final": result["history"][-1]["g_loss"],
+        "cv_loss_final": result["history"][-1]["cv_loss"],
+        "wall_seconds": round(time.time() - t_start, 1),
+        "timings": {k: round(v, 2) for k, v in result["timings"].items()},
+    }
+    with open(os.path.join(args.out, "quality_run.json"), "w") as fh:
+        json.dump(report, fh, indent=2)
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
