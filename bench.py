@@ -113,7 +113,11 @@ def bring_up_backend(retries: int, probe_timeout: float, backoff: float) -> dict
         },
     }
     for i in range(retries):
-        p = probe_backend(probe_timeout)
+        # escalate the budget: round-1's failure mode was an init that stalls
+        # many minutes — a short fixed probe would abandon a slow-but-alive
+        # chip, so later attempts wait up to 4x longer (capped so raised
+        # flags keep roughly the wall time they advertise)
+        p = probe_backend(probe_timeout * min(2 ** i, 4))
         diag["attempts"].append(p)
         print(f"# backend probe {i + 1}/{retries}: {p}", file=sys.stderr)
         if p.get("ok") and p.get("platform") != "cpu":
@@ -275,7 +279,8 @@ def main() -> None:
     p.add_argument("--retries", type=int, default=3,
                    help="backend probe attempts before CPU fallback")
     p.add_argument("--probe-timeout", type=float, default=150.0,
-                   help="seconds per backend-init probe")
+                   help="base seconds per backend-init probe (escalates up to "
+                        "4x on retries)")
     p.add_argument("--backoff", type=float, default=30.0,
                    help="base seconds between probe attempts")
     args = p.parse_args()

@@ -1,0 +1,120 @@
+"""Profile the fused DCGAN-MNIST iteration (round-1 VERDICT item 9).
+
+Captures, for the config-1 workload (batch 64):
+
+- a ``jax.profiler`` device trace (TensorBoard/Perfetto) under ``--trace-dir``,
+- per-phase wall-clock from PhaseTimer,
+- XLA post-optimization cost analysis of the fused program (FLOPs, bytes
+  accessed → arithmetic intensity), per compute dtype,
+- derived utilization (FLOPs / wall / peak) when on a known TPU.
+
+Writes ``--out`` (JSON) for the committed PROFILE.md analysis. ``--cpu``
+forces the host backend when no TPU is reachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def profile_once(compute_dtype, batch, iters, trace_dir):
+    import jax
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig, make_experiment
+    from gan_deeplearning4j_tpu.harness.experiment import shape_struct
+    from gan_deeplearning4j_tpu.runtime.dtype import compute_dtype_scope
+    from gan_deeplearning4j_tpu.utils.profiling import device_trace
+
+    cfg = ExperimentConfig(
+        batch_size_train=batch, batch_size_pred=batch,
+        num_iterations=iters, save_models=False, compute_dtype=compute_dtype,
+    )
+    exp = make_experiment(cfg)
+    rng = np.random.default_rng(0)
+    feats = exp.family.synthetic_data(batch, exp.model_cfg, 0)[:batch]
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
+
+    # warmup/compile outside the trace
+    losses = exp.train_iteration(feats, labels)
+    jax.block_until_ready(losses)
+
+    with device_trace(trace_dir):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with exp.timer.phase("fused_iteration") as sink:
+                losses = exp.train_iteration(feats, labels)
+                sink.extend(losses.values())
+        wall = (time.perf_counter() - t0) / iters
+
+    # post-optimization cost analysis of the fused executable
+    f32 = jnp.float32
+    args = (
+        shape_struct(exp.dis_state), shape_struct(exp.gan_state),
+        shape_struct(exp.cv_state), shape_struct(exp.gen_params),
+        jax.ShapeDtypeStruct((batch, cfg.num_features), f32),
+        jax.ShapeDtypeStruct((batch, cfg.num_classes), f32),
+        jax.ShapeDtypeStruct((batch, 1), f32),
+        jax.ShapeDtypeStruct((batch, 1), f32),
+    )
+    with compute_dtype_scope(exp._compute_dtype):
+        cost = exp._fused.lower(*args).compile().cost_analysis() or {}
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    return {
+        "compute_dtype": compute_dtype or "f32",
+        "sec_per_iter": round(wall, 5),
+        "images_per_sec": round(batch / wall, 2),
+        "flops_per_iter": flops,
+        "bytes_accessed_per_iter": bytes_accessed,
+        "arithmetic_intensity_flops_per_byte": round(flops / bytes_accessed, 2)
+        if bytes_accessed else None,
+        "achieved_flops_per_sec": round(flops / wall, 3) if flops else None,
+        "phase_report": exp.timer.report(),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--trace-dir", default="artifacts/trace")
+    ap.add_argument("--out", default="artifacts/profile.json")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    results = {
+        "platform": None, "device_kind": None, "batch": args.batch,
+        "runs": [],
+    }
+    for dtype in (None, "bf16"):
+        r = profile_once(dtype, args.batch, args.iters,
+                         args.trace_dir + ("_bf16" if dtype else "_f32"))
+        print(json.dumps({k: v for k, v in r.items() if k != "phase_report"}),
+              flush=True)
+        print(r["phase_report"], flush=True)
+        results["runs"].append(r)
+    results["platform"] = jax.default_backend()
+    results["device_kind"] = jax.devices()[0].device_kind
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(results, fh, indent=2)
+    print(f"wrote {args.out}; traces under {args.trace_dir}_*", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
