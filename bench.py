@@ -33,7 +33,13 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINES_FILE = os.path.join(_REPO, "BENCH_BASELINES.json")
 
 WARMUP_ITERS = 3
-TIMED_ITERS = 20
+TIMED_ITERS = 20  # chunk size AND the measurement floor
+# Keep timing until this much measured work has accumulated (round-2 VERDICT
+# weak #7: a fixed 20 iterations is ~0.17 s at TPU speed — inside host-jitter
+# noise). Chunks of TIMED_ITERS keep back-to-back iterations pipelined (no
+# per-iteration device sync); jitter is reported as the stddev across chunks.
+MIN_MEASURED_SECONDS = 2.0
+MAX_CHUNKS = 50
 
 # Peak dense-matmul throughput per chip, bf16 (the MFU denominator; MFU is
 # reported against the bf16 peak for BOTH compute dtypes — a consistent,
@@ -169,19 +175,32 @@ def _bench_experiment(family: str, batch: int, *, height=28, width=28, channels=
     for _ in range(WARMUP_ITERS):
         losses = exp.train_iteration(feats, labels)
     jax.block_until_ready(losses)
-    t0 = time.perf_counter()
-    for _ in range(TIMED_ITERS):
-        losses = exp.train_iteration(feats, labels)
-    jax.block_until_ready(losses)
-    elapsed = time.perf_counter() - t0
+    chunk_secs = []
+    while len(chunk_secs) < MAX_CHUNKS:
+        t0 = time.perf_counter()
+        for _ in range(TIMED_ITERS):
+            losses = exp.train_iteration(feats, labels)
+        jax.block_until_ready(losses)
+        chunk_secs.append(time.perf_counter() - t0)
+        if sum(chunk_secs) >= MIN_MEASURED_SECONDS:
+            break
+    elapsed = sum(chunk_secs)
+    iters = TIMED_ITERS * len(chunk_secs)
+    per_iter = np.asarray(chunk_secs) / TIMED_ITERS
     try:
         flops = exp.flops_per_iteration(batch)
     except Exception as exc:  # cost model must never sink the measurement
         print(f"# cost analysis failed: {type(exc).__name__}: {exc}", file=sys.stderr)
         flops = None
     return {
-        "items_per_sec": TIMED_ITERS * batch / elapsed,
-        "sec_per_iter": elapsed / TIMED_ITERS,
+        "items_per_sec": iters * batch / elapsed,
+        "sec_per_iter": elapsed / iters,
+        # cross-chunk jitter of the per-iteration time; None when the window
+        # closed in a single chunk (slow degraded-CPU run) — no variance
+        # estimate exists there, which is not the same as zero jitter
+        "sec_per_iter_std": float(per_iter.std(ddof=1)) if len(chunk_secs) > 1 else None,
+        "timed_iters": iters,
+        "measured_seconds": round(elapsed, 3),
         "flops_per_iter": flops,
     }
 
@@ -191,10 +210,16 @@ def _with_mfu(measure: dict, diag: dict) -> dict:
     mfu = None
     if peak and measure["flops_per_iter"]:
         mfu = measure["flops_per_iter"] / (measure["sec_per_iter"] * peak)
+    sec = measure["sec_per_iter"]
+    std = measure["sec_per_iter_std"]
     return {
         "value": measure["items_per_sec"],
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_iter": measure["flops_per_iter"],
+        "sec_per_iter": round(sec, 6),
+        "iter_time_jitter": round(std / sec, 4) if (sec and std is not None) else None,
+        "timed_iters": measure["timed_iters"],
+        "measured_seconds": measure["measured_seconds"],
     }
 
 

@@ -11,6 +11,7 @@ from gan_deeplearning4j_tpu.eval.fid import (
     FeatureStats,
     fid_from_stats,
     fid_score,
+    frozen_feature_fn,
     graph_feature_fn,
 )
 from gan_deeplearning4j_tpu.eval.images import render_manifold, tile_images, write_png
@@ -22,6 +23,7 @@ __all__ = [
     "FeatureStats",
     "fid_from_stats",
     "fid_score",
+    "frozen_feature_fn",
     "graph_feature_fn",
     "render_manifold",
     "tile_images",

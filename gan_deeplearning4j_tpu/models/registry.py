@@ -136,6 +136,40 @@ def _wgan_experiment(cfg, mesh):
     return WganGpExperiment(cfg, mesh=mesh)
 
 
+_BUILTINS = frozenset(_FAMILIES)
+
+
+def register(family: GanFamily, *, overwrite: bool = False) -> GanFamily:
+    """Add a user-defined family to the registry (the extension point the
+    reference lacks — its topology is hardwired in one Java class). The
+    experiment harness, bench, and CLI then accept ``family.name`` like any
+    built-in."""
+    if family.name in _ALIASES:
+        # get() resolves aliases before families — a family registered under
+        # an alias name would be silently unreachable
+        raise ValueError(
+            f"family name {family.name!r} collides with the "
+            f"{_ALIASES[family.name]!r} alias"
+        )
+    if family.name in _BUILTINS:
+        # irreversible either way: unregister refuses built-ins, so a
+        # clobbered one could never be restored
+        raise ValueError(f"cannot replace built-in family {family.name!r}")
+    if family.name in _FAMILIES and not overwrite:
+        raise ValueError(f"family {family.name!r} already registered")
+    _FAMILIES[family.name] = family
+    return family
+
+
+def unregister(name: str) -> None:
+    """Remove a user-registered family (tests use this to stay hermetic).
+    Built-ins are not removable — losing e.g. 'mnist' would break the
+    default bench/CLI path process-wide with a bare KeyError much later."""
+    if name in _BUILTINS:
+        raise ValueError(f"cannot unregister built-in family {name!r}")
+    _FAMILIES.pop(name, None)
+
+
 def names() -> Tuple[str, ...]:
     return tuple(_FAMILIES) + tuple(_ALIASES)
 

@@ -125,6 +125,44 @@ class TestFid:
         with pytest.raises(ValueError):
             FeatureStats.from_features(np.zeros((1, 3)))
 
+    def test_frozen_feature_fn_pinned(self):
+        """The stable extractor's feature space must NEVER move between
+        runs/rounds (round-2 VERDICT weak #4): pin exact values for a fixed
+        input. If this test fails, every historical FID number in
+        BASELINE.md/artifacts becomes incomparable — bump the seed and
+        re-score rather than silently changing the stack."""
+        from gan_deeplearning4j_tpu.eval.fid import frozen_feature_fn
+
+        fn = frozen_feature_fn(28, 28, 1, seed=666)
+        x = np.linspace(0, 1, 4 * 784, dtype=np.float32).reshape(4, 784)
+        feats = fn(x)
+        assert feats.shape == (4, 224)
+        np.testing.assert_allclose(
+            feats[0, :4],
+            [-0.041781, -0.240516, 0.094122, 1.407758],
+            rtol=2e-4, atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            feats[2, -4:],
+            [0.138992, 0.141423, 0.160424, -0.044636],
+            rtol=2e-4, atol=2e-5,
+        )
+        # independent of anything trained: a second instantiation is
+        # bit-identical
+        assert np.array_equal(feats, frozen_feature_fn(28, 28, 1, seed=666)(x))
+
+    def test_frozen_feature_fn_orders_models(self):
+        from gan_deeplearning4j_tpu.eval.fid import frozen_feature_fn
+
+        fn = frozen_feature_fn(8, 8, 1, seed=1)
+        rng = np.random.default_rng(5)
+        real = rng.random((256, 64), dtype=np.float32)
+        close = np.clip(real + 0.05 * rng.standard_normal(real.shape), 0, 1).astype(
+            np.float32
+        )
+        far = np.zeros_like(real)
+        assert fid_score(real, close, fn) < fid_score(real, far, fn)
+
     def test_graph_feature_fn_on_discriminator(self):
         from gan_deeplearning4j_tpu.models import dcgan_mnist
 

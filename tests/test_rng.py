@@ -1,0 +1,149 @@
+"""Per-step RNG threading (round-2 VERDICT weak #5).
+
+Dropout-style layers must see a FRESH key every optimizer step in both
+training paths — the phased ``GraphTrainer.train_step`` and the fused
+alternating iteration — or every iteration reuses identical masks (the
+reference topologies carry no dropout, dl4jGANComputerVision.java:117-314,
+so the bug would only bite future families; these tests pin the contract).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+from gan_deeplearning4j_tpu.models import registry
+from gan_deeplearning4j_tpu.models.registry import GanFamily
+from gan_deeplearning4j_tpu.nn import (
+    ComputationGraph,
+    DenseLayer,
+    DropoutLayer,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.optim import RmsProp
+from gan_deeplearning4j_tpu.parallel import GraphTrainer, TrainState
+
+FEATURES = 8
+Z = 2
+
+
+def _cfg(lr: float = 0.0) -> GraphConfig:
+    return GraphConfig(
+        seed=666, default_activation="tanh", weight_init="xavier",
+        l2=0.0, gradient_clip="elementwise", gradient_clip_value=1.0,
+        updater=RmsProp(lr, 1e-8, 1e-8), optimization_algo="sgd",
+    )
+
+
+def _dropout_dis_layers(b: GraphBuilder, prefix: str, lr: float, inp: str) -> str:
+    up = RmsProp(lr, 1e-8, 1e-8)
+    b.add_layer(f"{prefix}_dense_1", DenseLayer(n_out=16, updater=up), inp)
+    b.add_layer(f"{prefix}_drop_2", DropoutLayer(rate=0.5), f"{prefix}_dense_1")
+    b.add_layer(
+        f"{prefix}_output_3",
+        OutputLayer(n_out=1, activation="sigmoid", loss="xent", updater=up),
+        f"{prefix}_drop_2",
+    )
+    return f"{prefix}_output_3"
+
+
+def _build_dis(cfg) -> ComputationGraph:
+    b = GraphBuilder(_cfg())
+    b.add_inputs("dis_input_0")
+    b.set_input_types(InputType.feed_forward(FEATURES))
+    b.set_outputs(_dropout_dis_layers(b, "dis", 0.0, "dis_input_0"))
+    return b.build()
+
+
+def _build_gen(cfg) -> ComputationGraph:
+    b = GraphBuilder(_cfg())
+    b.add_inputs("gen_input_0")
+    b.set_input_types(InputType.feed_forward(Z))
+    b.add_layer(
+        "gen_dense_1",
+        DenseLayer(n_out=FEATURES, activation="sigmoid", updater=RmsProp(0.0, 1e-8, 1e-8)),
+        "gen_input_0",
+    )
+    b.set_outputs("gen_dense_1")
+    return b.build()
+
+
+def _build_gan(cfg) -> ComputationGraph:
+    b = GraphBuilder(_cfg())
+    b.add_inputs("gan_input_0")
+    b.set_input_types(InputType.feed_forward(Z))
+    b.add_layer(
+        "gan_dense_1",
+        DenseLayer(n_out=FEATURES, activation="sigmoid", updater=RmsProp(0.0, 1e-8, 1e-8)),
+        "gan_input_0",
+    )
+    b.set_outputs(_dropout_dis_layers(b, "gan_dis", 0.0, "gan_dense_1"))
+    return b.build()
+
+
+_DIS_TO_GAN = {
+    "dis_dense_1": "gan_dis_dense_1",
+    "dis_output_3": "gan_dis_output_3",
+}
+_GAN_TO_GEN = {"gan_dense_1": "gen_dense_1"}
+
+
+@pytest.fixture
+def dropout_family():
+    fam = GanFamily(
+        name="_dropout_test",
+        make_model_config=lambda cfg: cfg,
+        build_discriminator=_build_dis,
+        build_generator=_build_gen,
+        build_gan=_build_gan,
+        sync_maps=lambda cfg: (_DIS_TO_GAN, _GAN_TO_GEN),
+    )
+    registry.register(fam, overwrite=True)
+    yield fam
+    registry.unregister("_dropout_test")
+
+
+def test_train_step_key_varies_with_step():
+    """Same params + same batch at different step counters must produce
+    different dropout masks (the step is folded into the key inside the
+    jitted program); the same step must reproduce bit-identically."""
+    graph = _build_dis(None)
+    trainer = GraphTrainer(graph, donate=False)
+    state0 = trainer.init_state()
+    x = np.linspace(0, 1, 4 * FEATURES, dtype=np.float32).reshape(4, FEATURES)
+    y = np.ones((4, 1), np.float32)
+
+    _, loss_step0 = trainer.train_step(state0, x, y)
+    _, loss_step0_again = trainer.train_step(state0, x, y)
+    state1 = TrainState(state0.params, state0.opt_state, state0.step + 1)
+    _, loss_step1 = trainer.train_step(state1, x, y)
+
+    assert float(loss_step0) == float(loss_step0_again)  # deterministic
+    assert float(loss_step0) != float(loss_step1)  # fresh mask per step
+
+
+def test_fused_iteration_masks_vary_per_iteration(dropout_family):
+    """Fused-path regression: with ALL learning rates 0 (params frozen), a
+    zeroed generator (constant fake batch), and a fixed real batch, the only
+    thing that can change between iterations is the per-step rng — so the
+    d-loss sequence must NOT be constant. Under the old constant
+    ``PRNGKey(0)`` loss key it was."""
+    cfg = ExperimentConfig(
+        model_family="_dropout_test", batch_size_train=4, batch_size_pred=4,
+        num_features=FEATURES, height=FEATURES, width=1, channels=1,
+        z_size=Z, num_iterations=3, save_models=False,
+        dis_learning_rate=0.0, gen_learning_rate=0.0, l2=0.0,
+    )
+    exp = GanExperiment(cfg)
+    assert exp._fused is not None, "test must exercise the fused path"
+    # zero the sampler so the fake batch is z-independent (sigmoid(0)=0.5)
+    exp.gen_params = jax.tree_util.tree_map(jnp.zeros_like, exp.gen_params)
+
+    feats = np.linspace(0, 1, 4 * FEATURES, dtype=np.float32).reshape(4, FEATURES)
+    labels = np.eye(cfg.num_classes, dtype=np.float32)[np.arange(4) % cfg.num_classes]
+    losses = [float(exp.train_iteration(feats, labels)["d_loss"]) for _ in range(3)]
+    assert len(set(losses)) > 1, f"dropout masks repeated across iterations: {losses}"
