@@ -19,6 +19,7 @@ from gan_deeplearning4j_tpu.data.iterator import (
     ArrayDataSetIterator,
     DataSetIterator,
     DevicePrefetchIterator,
+    DeviceResidentIterator,
     RecordReaderDataSetIterator,
 )
 from gan_deeplearning4j_tpu.data.mnist import (
@@ -37,6 +38,7 @@ __all__ = [
     "ArrayDataSetIterator",
     "DataSetIterator",
     "DevicePrefetchIterator",
+    "DeviceResidentIterator",
     "RecordReaderDataSetIterator",
     "load_mnist_csv",
     "synthetic_mnist",

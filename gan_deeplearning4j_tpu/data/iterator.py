@@ -146,6 +146,135 @@ class ArrayDataSetIterator(DataSetIterator):
         self._cursor = 0
 
 
+class DeviceResidentIterator(DataSetIterator):
+    """Upload the WHOLE dataset to device HBM once; serve batches as
+    device-side slices.
+
+    For datasets that fit in HBM (MNIST-scale: tens of MB against ~16 GB),
+    this removes the host→device link from the steady state entirely — the
+    right call on a tunneled/remote accelerator, where re-uploading even an
+    identical batch costs multiple milliseconds (measured round 3: numpy
+    feeds were ~6x slower than resident batches at batch 64). Epoch order is
+    sequential; pass ``shuffle=True`` for a seeded per-epoch permutation
+    (host-side index draw, device-side ``take``).
+
+    With a mesh ``sharding`` the resident arrays land sharded over the data
+    axis; batch slices then reshard per step — prefer
+    :class:`DevicePrefetchIterator` per-batch placement for multi-device
+    meshes, this class for the single-chip hot path.
+    """
+
+    def __init__(
+        self,
+        features,
+        labels=None,
+        batch_size: int = 128,
+        shuffle: bool = False,
+        seed: int = 666,
+        drop_remainder: bool = False,
+        sharding=None,
+    ):
+        import jax.numpy as jnp
+
+        put = (
+            (lambda x: jax.device_put(np.asarray(x, np.float32), sharding))
+            if sharding is not None
+            else (lambda x: jnp.asarray(np.asarray(x, np.float32)))
+        )
+        self.features = put(features)
+        self.labels = put(labels) if labels is not None else None
+        if self.labels is not None and self.labels.shape[0] != self.features.shape[0]:
+            raise ValueError("features/labels row mismatch")
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self._epoch = 0
+        self._order = self._make_order()
+        self._cursor = 0
+
+    def _make_order(self):
+        self._windowed = None  # (nb, B, …) views rebuilt lazily per epoch
+        if not self.shuffle:
+            return None  # sequential: pure device slicing, no gather
+        rng = np.random.default_rng(self.seed + self._epoch)
+        import jax.numpy as jnp
+
+        return jnp.asarray(rng.permutation(self.features.shape[0]))
+
+    def _window_arrays(self):
+        """(nb, B, …) reshapes of the epoch's full batches, built with ONE
+        device op per epoch — ``next_window`` then serves a k-batch window
+        as a single slice instead of k per-batch dispatches (each dispatch
+        costs ~1 ms host-side on a tunneled chip; measured round 3)."""
+        if self._windowed is None:
+            import jax.numpy as jnp
+
+            b = self.batch_size
+            nb = self.features.shape[0] // b
+            feats = self.features
+            labels = self.labels
+            if self._order is not None:
+                feats = jnp.take(feats, self._order, axis=0)
+                labels = None if labels is None else jnp.take(labels, self._order, axis=0)
+            self._windowed = (
+                nb,
+                feats[: nb * b].reshape((nb, b) + feats.shape[1:]),
+                None
+                if labels is None
+                else labels[: nb * b].reshape((nb, b) + labels.shape[1:]),
+            )
+        return self._windowed
+
+    def next_window(self, k: int):
+        """Up to ``k`` consecutive full batches as ONE stacked (k', B, …)
+        device slice — k' is the largest power of two ≤ min(k, remaining
+        full batches), so callers compile a bounded set of window sizes.
+        Returns None when fewer than one full aligned batch remains (the
+        ragged tail and misaligned cursors fall back to ``next()``)."""
+        if k < 1 or self._cursor % self.batch_size != 0:
+            return None
+        nb, wf, wl = self._window_arrays()
+        at = self._cursor // self.batch_size
+        avail = min(k, nb - at)
+        if avail < 1:
+            return None
+        take = 1 << (avail.bit_length() - 1)
+        self._cursor += take * self.batch_size
+        return (
+            wf[at : at + take],
+            None if wl is None else wl[at : at + take],
+        )
+
+    def has_next(self) -> bool:
+        remaining = self.features.shape[0] - self._cursor
+        if self.drop_remainder:
+            return remaining >= self.batch_size
+        return remaining > 0
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        import jax.numpy as jnp
+
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self.features.shape[0])
+        self._cursor = hi
+        if self._order is None:
+            feats = self.features[lo:hi]
+            labels = None if self.labels is None else self.labels[lo:hi]
+        else:
+            idx = self._order[lo:hi]
+            feats = jnp.take(self.features, idx, axis=0)
+            labels = None if self.labels is None else jnp.take(self.labels, idx, axis=0)
+        return DataSet(feats, labels)
+
+    def reset(self) -> None:
+        self._epoch += 1
+        self._order = self._make_order()
+        self._cursor = 0
+
+
 class DevicePrefetchIterator(DataSetIterator):
     """Wrap any DataSetIterator with ahead-of-time device placement.
 

@@ -82,6 +82,13 @@ class ExperimentConfig:
     # -- observability --------------------------------------------------------
     metrics_jsonl: Optional[str] = None
     profile_dir: Optional[str] = None
+    # Fetch loss scalars from the device every k iterations in ONE batched
+    # read (a per-step read is a pipeline barrier — ~200 ms through a
+    # tunneled chip vs ~2-4 ms of device work; the reference never reads
+    # losses at all, SURVEY §5). 1 = fetch every step. Also the device-loop
+    # window bound: larger values amortize both the fetch and per-dispatch
+    # latency further (the fetch costs ~90 ms fixed regardless of k).
+    loss_fetch_every: int = 128
 
     def validate(self) -> "ExperimentConfig":
         if self.model_family != "tabular" and self.num_features != (

@@ -14,6 +14,7 @@ from gan_deeplearning4j_tpu.runtime.prng import RngStream
 from gan_deeplearning4j_tpu.runtime.environment import (
     TpuEnvironment,
     backend_info,
+    enable_compilation_cache,
     initialize_distributed,
 )
 
@@ -24,5 +25,6 @@ __all__ = [
     "RngStream",
     "TpuEnvironment",
     "backend_info",
+    "enable_compilation_cache",
     "initialize_distributed",
 ]

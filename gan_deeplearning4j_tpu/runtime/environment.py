@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -37,6 +38,39 @@ def backend_info() -> dict:
         "process_count": jax.process_count(),
         "devices": [str(d) for d in devices],
     }
+
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_cache_enabled: Optional[str] = None  # the active cache dir, once applied
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Persistent XLA compilation cache (idempotent; on by default for the
+    experiment harness).
+
+    Scan/fused programs cost ~15-40 s each to compile on TPU; the cache
+    brings a warm process start to seconds (measured round 3: 19 s → 2.9 s
+    for one scan program). Default location is ``.jax_cache/`` at the repo
+    root (gitignored); override with ``$GDT_COMPILATION_CACHE`` (``"off"``
+    disables). Returns the cache dir, or None when disabled/unsupported."""
+    global _cache_enabled
+    path = path or os.environ.get("GDT_COMPILATION_CACHE") or os.path.join(
+        _REPO_ROOT, ".jax_cache"
+    )
+    if path == "off":
+        return None
+    if _cache_enabled == path:  # already active at this exact directory
+        return path
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _cache_enabled = path
+        return path
+    except Exception as exc:  # unsupported backend/jax version: run uncached
+        logger.warning("compilation cache unavailable: %s", exc)
+        return None
 
 
 def initialize_distributed(

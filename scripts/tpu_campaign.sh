@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-3 on-chip campaign, tunnel-outage-tolerant: waits for the TPU to
+# answer, then runs the full bench (writing BENCH_BASELINES.json) and the
+# long quality run. Safe to re-run; logs to bench_all.log / quality_run.log.
+cd /root/repo
+for i in $(seq 1 200); do
+  echo "$(date +%H:%M:%S) probe $i" >> tpu_poller.log
+  if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) TPU up — running campaign" >> tpu_poller.log
+    python bench.py --config all --json artifacts/benchmarks.json --update-baselines > bench_all.log 2>&1
+    echo "$(date +%H:%M:%S) bench rc=$?" >> tpu_poller.log
+    python scripts/quality_run.py --iterations 4000 --batch 200 > quality_run.log 2>&1
+    echo "$(date +%H:%M:%S) quality rc=$?" >> tpu_poller.log
+    exit 0
+  fi
+  sleep 100
+done
+echo "$(date +%H:%M:%S) gave up" >> tpu_poller.log

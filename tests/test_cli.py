@@ -8,6 +8,7 @@ test caught because nothing exercised ``main()``. These do.
 import os
 
 import numpy as np
+import pytest
 
 from gan_deeplearning4j_tpu.__main__ import main
 
@@ -26,6 +27,7 @@ def _args(tmp_path, *extra):
 
 
 class TestMain:
+    @pytest.mark.slow
     def test_main_mnist_end_to_end(self, tmp_path, capsys):
         """Full default path: synthetic data generation, training, offline
         eval (accuracy print + manifold PNG) — the block that crashed in
@@ -38,6 +40,7 @@ class TestMain:
         png = tmp_path / "out" / "DCGAN_Generated_Images.png"
         assert png.exists() and png.stat().st_size > 0
 
+    @pytest.mark.slow
     def test_main_picks_latest_export(self, tmp_path):
         """The offline eval must read the highest-index export."""
         rc = main(_args(tmp_path))

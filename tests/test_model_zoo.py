@@ -16,6 +16,7 @@ from gan_deeplearning4j_tpu.runtime import TpuEnvironment
 
 
 class TestMlpGan:
+    @pytest.mark.slow
     def test_shapes(self):
         cfg = mlp_gan.MlpGanConfig(num_features=16, z_size=4, hidden=(32, 32))
         dis, gen, gan = (
@@ -129,6 +130,7 @@ class TestWganGp:
             dense_width=16, n_critic=2,
         )
 
+    @pytest.mark.slow
     def test_shapes_and_round(self):
         cfg = self._small()
         tr = wgan_gp.WganGpTrainer(cfg)

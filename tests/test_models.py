@@ -157,6 +157,7 @@ class TestTransferClassifier:
 
 
 class TestGanGradientFlow:
+    @pytest.mark.slow
     def test_generator_gets_gradients_through_frozen_dis(self, graphs):
         """One XENT loss at the stacked head; generator layers must receive
         nonzero grads through the frozen tail (the whole point of the gan
