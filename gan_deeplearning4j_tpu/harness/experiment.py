@@ -158,6 +158,7 @@ class GanExperiment:
         )
         # the scan-of-K device loop, built lazily on first train_iterations
         self._fused_multi = None
+        self._supports_device_loop = self._fused is not None
 
     # ------------------------------------------------------------------
     def _make_trainer(self, graph: ComputationGraph):
@@ -325,18 +326,24 @@ class GanExperiment:
             kwargs["out_shardings"] = (rep,) * 4 + (rep,) * 3
         return jax.jit(multi, **kwargs)
 
-    def _soft_labels(self, b: int):
-        """Fixed softened labels (1+ε, 0+ε) for batch size ``b``, resident in
-        HBM, extending the once-sampled noise when a larger batch appears
-        (preserves the reference's sample-once quirk, :404-406)."""
+    def _eps_slices(self, b: int):
+        """The once-sampled label noise for batch size ``b``, extending it
+        when a larger batch appears (the extension is itself drawn once and
+        reused — preserves the reference's sample-once quirk, :404-406)."""
         if b > self._eps_real.shape[0]:
             extra = b - self._eps_real.shape[0]
             self._eps_real = np.concatenate([self._eps_real, self._soft_noise(extra)])
             self._eps_fake = np.concatenate([self._eps_fake, self._soft_noise(extra)])
+        return self._eps_real[:b], self._eps_fake[:b]
+
+    def _soft_labels(self, b: int):
+        """Fixed softened labels (1+ε, 0+ε) for batch size ``b``, resident
+        in HBM, cached per batch size."""
         if b not in self._soft_cache:
+            eps_r, eps_f = self._eps_slices(b)
             self._soft_cache[b] = (
-                jnp.asarray(1.0 + self._eps_real[:b]),
-                jnp.asarray(0.0 + self._eps_fake[:b]),
+                jnp.asarray(1.0 + eps_r),
+                jnp.asarray(0.0 + eps_f),
             )
         return self._soft_cache[b]
 
@@ -413,20 +420,17 @@ class GanExperiment:
         floats. ``run()`` normalizes to floats before logging."""
         cfg = self.config
         b = int(real_features.shape[0])
-        if cfg.resample_label_noise:
-            eps_r, eps_f = self._soft_noise(b), self._soft_noise(b)
-        else:
-            # extends the once-sampled noise for oversized batches and
-            # caches the device-resident softened labels per batch size
-            soft1, soft0 = self._soft_labels(b)
-            eps_r, eps_f = self._eps_real[:b], self._eps_fake[:b]
         real_features = jnp.asarray(real_features)
         real_labels = jnp.asarray(real_labels)
 
         if self._fused is not None:
             if cfg.resample_label_noise:
-                soft1 = jnp.asarray(1.0 + eps_r)
-                soft0 = jnp.asarray(0.0 + eps_f)
+                soft1 = jnp.asarray(1.0 + self._soft_noise(b))
+                soft0 = jnp.asarray(0.0 + self._soft_noise(b))
+            else:
+                # extends the once-sampled noise for oversized batches and
+                # caches the device-resident softened labels per batch size
+                soft1, soft0 = self._soft_labels(b)
             with self.timer.phase("train_fused"):
                 (
                     self.dis_state,
@@ -443,6 +447,12 @@ class GanExperiment:
             # losses stay on device — the reference never logs losses at all
             # (SURVEY §5), so don't stall the pipeline; callers float() lazily
             return {"d_loss": d_loss, "g_loss": g_loss, "cv_loss": cv_loss}
+
+        # phased (param-averaging) path: host-side softened labels
+        if cfg.resample_label_noise:
+            eps_r, eps_f = self._soft_noise(b), self._soft_noise(b)
+        else:
+            eps_r, eps_f = self._eps_slices(b)
 
         # (a) fake batch from the frozen sampler
         with self.timer.phase("sample_fake") as sink:
@@ -634,7 +644,7 @@ class GanExperiment:
         trainer, per-batch label-noise resampling, and loss_fetch_every=1."""
         cfg = self.config
         if (
-            getattr(self, "_fused", None) is None  # phased path; WGAN-GP subclass
+            not getattr(self, "_supports_device_loop", False)  # phased path
             or cfg.resample_label_noise
             or cfg.save_models
             or cfg.loss_fetch_every <= 1
@@ -775,7 +785,9 @@ class GanExperiment:
                         with self.timer.phase("train_window"):
                             losses = self.train_iterations(
                                 jnp.stack([jnp.asarray(b.features) for b in batches]),
-                                jnp.stack([jnp.asarray(b.labels) for b in batches]),
+                                None
+                                if batches[0].labels is None
+                                else jnp.stack([jnp.asarray(b.labels) for b in batches]),
                             )
                 pending.append((self.batch_counter, losses, images))
                 pending_iters += n_window

@@ -55,6 +55,9 @@ class WganGpExperiment(GanExperiment):
     def __init__(self, config: ExperimentConfig = None, mesh=None):
         # deliberately NOT calling GanExperiment.__init__: the three-graph
         # protocol does not apply; only run()'s loop is shared
+        from gan_deeplearning4j_tpu.runtime.environment import enable_compilation_cache
+
+        enable_compilation_cache()  # skipped super().__init__ would have done this
         config = config if config is not None else ExperimentConfig(model_family="wgan_gp")
         self.config = config.validate()
         cfg = config
@@ -84,6 +87,9 @@ class WganGpExperiment(GanExperiment):
         self.timer = PhaseTimer()
         self.metrics = MetricsLogger(cfg.metrics_jsonl)
         self.batch_counter = 0
+        # run()'s windowed device loop works here too (train_iterations
+        # scans whole WGAN-GP rounds)
+        self._supports_device_loop = True
 
     # ------------------------------------------------------------------
     def train_iteration(self, real_features, real_labels=None) -> Dict:
@@ -118,6 +124,40 @@ class WganGpExperiment(GanExperiment):
             )
         # device scalars, same contract as the fused DCGAN path
         return {"d_loss": c_loss, "g_loss": g_loss, "cv_loss": jnp.float32(jnp.nan)}
+
+    def train_iterations(self, features, labels=None) -> Dict:
+        """K WGAN-GP rounds in ONE device dispatch (the scan device loop —
+        same contract as GanExperiment.train_iterations). ``features``:
+        (K, B, num_features); a B not divisible by n_critic gets the same
+        tail policy as the sequential round (pad-by-cycling / drop
+        remainder). ``labels`` accepted and ignored — the critic is
+        unsupervised."""
+        del labels
+        n = self.model_cfg.n_critic
+        with compute_dtype_scope(self._compute_dtype):
+            rounds = jnp.asarray(features, jnp.float32)
+            k, b = int(rounds.shape[0]), int(rounds.shape[1])
+            # same tail policy as _train_round: pad-by-cycling below one row
+            # per critic step, else drop the < n_critic remainder rows
+            if b < n:
+                rounds = jnp.tile(rounds, (1, -(-n // b), 1))[:, :n]
+                b = n
+            elif b % n:
+                b = (b // n) * n
+                rounds = rounds[:, :b]
+            rounds = rounds.reshape(k, n, b // n, -1)
+            self._key, sub = jax.random.split(self._key)
+            with self.timer.phase("train_rounds"):
+                (
+                    self.critic_state,
+                    self.gen_state,
+                    c_losses,
+                    g_losses,
+                ) = self.trainer.train_rounds(
+                    self.critic_state, self.gen_state, rounds, sub
+                )
+        nan = jnp.full((k,), jnp.nan, jnp.float32)
+        return {"d_loss": c_losses, "g_loss": g_losses, "cv_loss": nan}
 
     @property
     def gen_params(self):

@@ -221,6 +221,7 @@ class WganGpTrainer:
             )
             return new_state, jnp.mean(losses)
 
+        self._round_body = round_fn  # traceable body, reused by _build_multi_round
         kwargs = {"donate_argnums": (0,)}
         sh = self._shardings()
         if sh:
@@ -246,12 +247,53 @@ class WganGpTrainer:
             params, opt_state = self.gen_opt.step(new_params, grads, gen_state.opt_state)
             return TrainState(params, opt_state, gen_state.step + 1), loss
 
+        self._gen_body = step  # traceable body, reused by _build_multi_round
         kwargs = {"donate_argnums": (0,)}
         sh = self._shardings()
         if sh:
             kwargs["in_shardings"] = (sh["rep"], sh["rep"], sh["data"])
             kwargs["out_shardings"] = (sh["rep"], sh["rep"])
         return jax.jit(step, **kwargs)
+
+    def _build_multi_round(self):
+        """K full WGAN-GP rounds (n_critic critic steps + one generator step
+        each) as ONE scanned XLA program — the device training loop, same
+        shape as GanExperiment.train_iterations (round-3 perf work: each
+        dispatch through a tunneled chip costs milliseconds, so the host
+        feeds round WINDOWS instead of rounds)."""
+        round_body = self._round_body
+        gen_body = self._gen_body
+
+        def multi(critic_state, gen_state, rounds, rng):
+            """rounds: (K, n_critic, B, F); rng: one key for the window."""
+
+            def body(carry, xs):
+                cs, gs = carry
+                real_batches, key = xs
+                k_c, k_g = jax.random.split(key)
+                cs, c_loss = round_body(cs, gs.params, real_batches, k_c)
+                z = jax.random.normal(
+                    k_g, (real_batches.shape[1], self.cfg.z_size), real_batches.dtype
+                )
+                gs, g_loss = gen_body(gs, cs.params, z)
+                return (cs, gs), (c_loss, g_loss)
+
+            keys = jax.random.split(rng, rounds.shape[0])
+            (cs, gs), (c_losses, g_losses) = jax.lax.scan(
+                body, (critic_state, gen_state), (rounds, keys)
+            )
+            return cs, gs, c_losses, g_losses
+
+        kwargs = {"donate_argnums": (0, 1)}
+        sh = self._shardings()
+        if sh:
+            rounds_sh = jax.sharding.NamedSharding(
+                self.mesh,
+                jax.sharding.PartitionSpec(None, None, self.data_axis),
+            )
+            kwargs["in_shardings"] = (sh["rep"], sh["rep"], rounds_sh, sh["rep"])
+            kwargs["out_shardings"] = (sh["rep"],) * 4
+        return jax.jit(multi, **kwargs)
 
     # -- public steps -------------------------------------------------------
     def train_round(
@@ -273,6 +315,22 @@ class WganGpTrainer:
         )
         gen_state, g_loss = self._gen_step(gen_state, critic_state.params, z)
         return critic_state, gen_state, c_loss, g_loss
+
+    def train_rounds(self, critic_state, gen_state, rounds, rng):
+        """K rounds in one dispatch. ``rounds``: (K, n_critic, B, features).
+        Returns (critic_state, gen_state, c_losses (K,), g_losses (K,)) —
+        losses stay on device. Per-round RNG derives from one window key
+        (split K ways), vs ``train_round``'s one host split per call —
+        statistically equivalent streams, not bit-identical ones."""
+        rounds = jnp.asarray(rounds)
+        if rounds.ndim != 4 or rounds.shape[1] != self.cfg.n_critic:
+            raise ValueError(
+                f"rounds must be (K, n_critic={self.cfg.n_critic}, B, F); "
+                f"got {rounds.shape}"
+            )
+        if getattr(self, "_multi_round", None) is None:
+            self._multi_round = self._build_multi_round()
+        return self._multi_round(critic_state, gen_state, rounds, jnp.asarray(rng))
 
     def sample(self, gen_state: TrainState, rng, num: int):
         """Generate ``num`` images (num, H, W, C) for eval/FID."""

@@ -42,11 +42,6 @@ def main() -> int:
     ap.add_argument("--compute-dtype", default=None)
     ap.add_argument("--cpu", action="store_true", help="force the host backend")
     ap.add_argument("--seed", type=int, default=666)
-    ap.add_argument("--prefetch", type=int, default=0,
-                    help="DevicePrefetchIterator depth for HOST-backed "
-                         "iterators; irrelevant here (the training set is "
-                         "device-resident, which run() detects and never "
-                         "wraps)")
     args = ap.parse_args()
 
     import jax
@@ -83,7 +78,6 @@ def main() -> int:
         output_dir=args.out,
         compute_dtype=args.compute_dtype,
         seed=args.seed,
-        prefetch=args.prefetch,
     )
     exp = GanExperiment(cfg)
     # whole dataset resident in HBM once — steady state has NO host→device
