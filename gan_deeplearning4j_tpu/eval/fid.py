@@ -133,6 +133,10 @@ def frozen_feature_fn(
             chunks.append(np.asarray(fwd(jnp.asarray(samples[i : i + batch_size]))))
         return np.concatenate(chunks, axis=0)
 
+    # the raw jittable (N,·)→(N,224) forward, for callers composing the
+    # extractor with other device computations (e.g. generator→features in
+    # one dispatch, scripts/quality_run.py's in-training tracker)
+    extract.forward = forward
     return extract
 
 

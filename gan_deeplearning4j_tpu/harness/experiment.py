@@ -660,8 +660,15 @@ class GanExperiment:
             w = min(w, 1 if r == 0 else every - r + 1)
         return max(1, w)
 
-    def run(self, train_iterator, test_iterator=None) -> Dict:
+    def run(self, train_iterator, test_iterator=None, eval_callback=None) -> Dict:
         """The training loop — host feeds WINDOWS, the device runs them.
+
+        ``eval_callback(experiment, index)``, when given, fires at every
+        ``print_every`` boundary (the manifold-export cadence, where window
+        construction guarantees the model state is current) — the hook for
+        in-training evaluation such as FID-based best-checkpoint selection
+        (``scripts/quality_run.py``). It runs on the host between windows, so
+        its cost gates training only at boundaries, never inside a window.
 
         Up to ``config.loss_fetch_every`` iterations at a time execute as one
         ``lax.scan`` dispatch (``train_iterations``); loss scalars come back
@@ -800,6 +807,9 @@ class GanExperiment:
                     if self.batch_counter % cfg.print_every == 0:
                         with self.timer.phase("export_manifold"):
                             self.export_manifold(index)
+                        if eval_callback is not None:
+                            with self.timer.phase("eval_callback"):
+                                eval_callback(self, index)
                     if have_predictions and self.batch_counter % cfg.save_every == 0:
                         with self.timer.phase("export_predictions"):
                             self.export_predictions(test_iterator, index)

@@ -7,11 +7,23 @@ the reference implies (gan.ipynb cells 5–6 + ``DCGAN_Generated_Images.png``):
 
 - the 10×10 latent-manifold PNG (committed into ``artifacts/``),
 - transfer-classifier accuracy on the held-out test split,
-- FID@50k: 50k generator samples vs the real set, features tapped from the
-  trained discriminator's ``dis_dense_layer_6`` (the layer the reference's
-  transfer classifier trusts; no Inception weights exist offline —
+- FID@50k under the FROZEN extractor (longitudinally comparable) plus the
+  dis-feature diagnostic FID (no Inception weights exist offline —
   BASELINE.md "Data provenance"),
 - per-iteration throughput stats.
+
+Generator quality is NOT monotone in training time — the classifier keeps
+improving long after the discriminator has overpowered the generator (round-3
+observation: 96% accuracy at iteration 4000, but the best-looking manifold
+near iteration 2000). The run therefore tracks a quick frozen-feature FID at
+every export boundary (``GanExperiment.run``'s ``eval_callback`` hook, one
+fused generator→features device program per eval), snapshots the best
+generator (device-side copies; train steps donate their buffers), and
+reports BOTH: the headline manifold PNG comes from the best checkpoint
+(paired with ``fid_frozen_features_best``), while the longitudinal metric
+``fid_frozen_features`` stays bound to the FINAL model — selection minimizes
+that very metric, so a min-over-checkpoints headline would carry best-of-N
+bias across rounds.
 
 Writes ``artifacts/quality_run.json`` + the PNG; run with ``--cpu`` to force
 the host backend when no TPU is reachable.
@@ -42,6 +54,10 @@ def main() -> int:
     ap.add_argument("--compute-dtype", default=None)
     ap.add_argument("--cpu", action="store_true", help="force the host backend")
     ap.add_argument("--seed", type=int, default=666)
+    ap.add_argument("--no-select-best", action="store_true",
+                    help="skip in-training FID tracking / best-checkpoint selection")
+    ap.add_argument("--select-samples", type=int, default=1024,
+                    help="generator samples per in-training quick-FID eval")
     args = ap.parse_args()
 
     import jax
@@ -89,7 +105,60 @@ def main() -> int:
     test_csv = os.path.join(args.out, "quality_test.csv")
     write_mnist_csv(test_csv, xte, yte)
 
-    result = exp.run(train_it, test_it)
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.eval.fid import FeatureStats, fid_from_stats
+
+    frozen_fn = frozen_feature_fn(cfg.height, cfg.width, cfg.channels, seed=666)
+
+    # Real-set feature stats under the frozen extractor: computed ONCE and
+    # reused by the quick-FID tracker and both full FID@50k scores below.
+    real_stats = FeatureStats.from_features(frozen_fn(xtr))
+
+    # In-training quick-FID tracker: fixed z (paired across evals). The
+    # generator→frozen-features composition runs as ONE jitted device
+    # program returning only (N, 224) features — no sample round-trip — and
+    # the best params are snapshotted as fresh DEVICE copies (the train step
+    # donates its buffers, so references must be copied; copying on device
+    # avoids a ~tens-of-MB host download through the tunnel per improvement,
+    # which early training would pay at nearly every boundary).
+    best = {"iteration": None, "fid": None, "gen_params": None, "curve": []}
+    eval_callback = None
+    if not args.no_select_best:
+        z_eval = np.random.default_rng(args.seed + 13).random(
+            (args.select_samples, cfg.z_size), dtype=np.float32
+        ) * 2.0 - 1.0
+        z_eval_dev = jnp.asarray(z_eval)
+        gen_features = jax.jit(
+            lambda p, z: frozen_fn.forward(exp._gen_fwd(p, z))
+        )
+
+        def score_and_track(e, index):
+            from gan_deeplearning4j_tpu.runtime.dtype import compute_dtype_scope
+
+            with compute_dtype_scope(e._compute_dtype):
+                feats = np.asarray(gen_features(e.gen_params, z_eval_dev))
+            fid_q = fid_from_stats(
+                real_stats, FeatureStats.from_features(feats)
+            )
+            best["curve"].append([index, round(fid_q, 3)])
+            if best["fid"] is None or fid_q < best["fid"]:
+                best.update(
+                    iteration=index, fid=fid_q,
+                    gen_params=jax.tree_util.tree_map(jnp.copy, e.gen_params),
+                )
+
+        eval_callback = score_and_track
+
+    result = exp.run(train_it, test_it, eval_callback=eval_callback)
+    if eval_callback is not None and not (
+        best["curve"] and best["curve"][-1][0] == result["iterations"]
+    ):
+        # the callback cadence usually misses the last iteration (it fires at
+        # batch_counter % export_every == 0) — score the final generator too,
+        # unless the cadence did land on it, so a monotone-improving run
+        # selects the final state exactly once
+        score_and_track(exp, result["iterations"])
     ips = [h["images_per_sec"] for h in result["history"]]
     print(
         f"trained {result['iterations']} iterations; "
@@ -97,15 +166,47 @@ def main() -> int:
         flush=True,
     )
     exp.save_models()
+    # when the final iteration won the selection, best and final are the same
+    # params — the final artifacts ARE the headline artifacts, so skip the
+    # separate best-checkpoint render/zip/FID entirely
+    selection_ran = best["iteration"] is not None
+    best_is_final = not selection_ran or best["iteration"] == result["iterations"]
 
-    # manifold PNG (the DCGAN_Generated_Images.png artifact)
+    # manifold PNGs (the DCGAN_Generated_Images.png artifact): headline from
+    # the best-FID checkpoint when selection ran, final-iteration alongside
     manifold_csv = exp.export_manifold(result["iterations"])
+    final_png_name = (
+        "DCGAN_Generated_Images.png"
+        if best_is_final else "DCGAN_Generated_Images_final.png"
+    )
     png = render_manifold(
         manifold_csv,
-        os.path.join(args.out, "DCGAN_Generated_Images.png"),
+        os.path.join(args.out, final_png_name),
         grid=cfg.latent_grid, side=cfg.height, channels=cfg.channels,
     )
-    print(f"manifold: {png}", flush=True)
+    print(f"final-iteration manifold: {png}", flush=True)
+    if not best_is_final:
+        from gan_deeplearning4j_tpu.utils.serializer import write_model
+
+        final_gen_params = exp.gen_params
+        exp.gen_params = best["gen_params"]  # already a device-resident copy
+        best_csv = exp.export_manifold(f"best_{best['iteration']}")
+        png = render_manifold(
+            best_csv,
+            os.path.join(args.out, "DCGAN_Generated_Images.png"),
+            grid=cfg.latent_grid, side=cfg.height, channels=cfg.channels,
+        )
+        # persist the generator the headline artifacts come from — the
+        # regular save_models() zips hold the final-iteration state
+        best_zip = os.path.join(
+            args.out, f"{cfg.file_prefix}_gen_model_best.zip"
+        )
+        write_model(best_zip, exp.gen, exp.gen_params, save_updater=False)
+        exp.gen_params = final_gen_params
+        print(
+            f"best-checkpoint manifold (iteration {best['iteration']}, "
+            f"quick-FID {best['fid']:.2f}): {png}  saved: {best_zip}", flush=True,
+        )
 
     # accuracy (cell-6 flow, in-process)
     preds_csv = exp.export_predictions(test_it, result["iterations"])
@@ -113,30 +214,44 @@ def main() -> int:
     acc = accuracy_score(preds, yte)
     print(f"transfer-classifier accuracy: {acc * 100:.2f}%", flush=True)
 
-    # FID@50k: generator samples vs the real training set, dis features
-    import jax.numpy as jnp
+    # FID@50k: generator samples vs the real training set. Headline FID: the
+    # FROZEN seeded extractor — feature space fixed across runs/rounds/models
+    # (round-2 VERDICT weak #4), so this number is longitudinally comparable.
+    # The dis-feature FID stays as a secondary, model-space diagnostic.
+    def sample_fakes(params) -> np.ndarray:
+        rng = np.random.default_rng(args.seed + 7)
+        fakes = []
+        bs = 1000
+        from gan_deeplearning4j_tpu.runtime.dtype import compute_dtype_scope
 
-    rng = np.random.default_rng(args.seed + 7)
-    fakes = []
-    bs = 1000
+        with compute_dtype_scope(exp._compute_dtype):
+            for i in range(0, args.fid_samples, bs):
+                n = min(bs, args.fid_samples - i)
+                z = rng.random((n, cfg.z_size), dtype=np.float32) * 2.0 - 1.0
+                out = exp._gen_fwd(params, jnp.asarray(z))
+                fakes.append(np.asarray(out).reshape(n, cfg.num_features))
+        return np.concatenate(fakes, axis=0)
+
+    def frozen_fid(fakes) -> float:
+        return fid_from_stats(
+            real_stats, FeatureStats.from_features(frozen_fn(fakes))
+        )
+
     t0 = time.time()
-    for i in range(0, args.fid_samples, bs):
-        n = min(bs, args.fid_samples - i)
-        z = rng.random((n, cfg.z_size), dtype=np.float32) * 2.0 - 1.0
-        out = exp._gen_fwd(exp.gen_params, jnp.asarray(z))
-        fakes.append(np.asarray(out).reshape(n, cfg.num_features))
-    fakes = np.concatenate(fakes, axis=0)
-    # headline FID: the FROZEN seeded extractor — feature space fixed across
-    # runs/rounds/models (round-2 VERDICT weak #4), so this number is
-    # longitudinally comparable. The dis-feature FID stays as a secondary,
-    # model-space diagnostic.
-    frozen_fn = frozen_feature_fn(cfg.height, cfg.width, cfg.channels, seed=666)
-    fid = fid_score(xtr, fakes, frozen_fn)
+    fakes = sample_fakes(exp.gen_params)
+    fid = frozen_fid(fakes)
     dis_fn = graph_feature_fn(
         exp.dis, exp.dis_state.params, "dis_dense_layer_6", batch_size=500
     )
     fid_dis = fid_score(xtr, fakes, dis_fn)
-    print(f"FID@{args.fid_samples // 1000}k frozen-features: {fid:.2f}  "
+    fid_best = None
+    if not best_is_final:
+        fid_best = frozen_fid(sample_fakes(best["gen_params"]))
+        print(f"FID@{args.fid_samples // 1000}k best checkpoint "
+              f"(iteration {best['iteration']}): {fid_best:.2f}", flush=True)
+    elif selection_ran:  # final won — its FID is the best checkpoint's
+        fid_best = fid
+    print(f"FID@{args.fid_samples // 1000}k frozen-features (final): {fid:.2f}  "
           f"dis-features (diagnostic): {fid_dis:.2f} "
           f"({time.time() - t0:.0f}s)", flush=True)
 
@@ -149,8 +264,23 @@ def main() -> int:
         "device_kind": jax.devices()[0].device_kind,
         "accuracy": round(float(acc), 4),
         "fid_at": args.fid_samples,
+        # ALWAYS the final model — the longitudinally comparable figure
+        # (selection minimizes this very metric, so a min-over-checkpoints
+        # value would carry best-of-N bias; the selected value lives under
+        # fid_frozen_features_best / best_checkpoint, paired with the
+        # headline PNG)
         "fid_frozen_features": round(float(fid), 3),
+        "fid_frozen_features_best": (
+            None if fid_best is None else round(float(fid_best), 3)
+        ),
         "fid_dis_features": round(float(fid_dis), 3),
+        "best_checkpoint": None if not selection_ran else {
+            "iteration": best["iteration"],
+            "is_final": best_is_final,
+            "quick_fid": round(float(best["fid"]), 3),
+            "fid_frozen_features": round(float(fid_best), 3),
+            "quick_fid_curve": best["curve"],
+        },
         "images_per_sec_median": round(float(np.median(ips)), 2),
         "d_loss_final": result["history"][-1]["d_loss"],
         "g_loss_final": result["history"][-1]["g_loss"],

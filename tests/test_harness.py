@@ -190,6 +190,30 @@ class TestExperimentLoop:
             ExperimentConfig(compute_dtype="fp8").validate()
 
     @pytest.mark.slow
+    def test_eval_callback_fires_at_export_boundaries(self, tmp_path):
+        """run(eval_callback=...) must fire exactly at the print_every
+        cadence with the model state current (the best-checkpoint selection
+        hook scripts/quality_run.py builds on) — including through the
+        windowed device-loop path."""
+        cfg = tiny_config(
+            tmp_path, num_iterations=4, print_every=2, save_models=False,
+            loss_fetch_every=4,
+        )
+        exp = GanExperiment(cfg)
+        train, _ = iterators()
+        seen = []
+
+        def cb(e, index):
+            assert e is exp
+            # state is current: the gan step counter equals the iterations
+            # completed at this boundary (batch_counter + the one just run)
+            seen.append((index, int(e.gan_state.step)))
+
+        result = exp.run(train, eval_callback=cb)
+        assert result["iterations"] == 4
+        assert seen == [(1, 1), (3, 3)]  # batch_counter 0 and 2
+
+    @pytest.mark.slow
     def test_distributed_pmean_mode(self, tmp_path):
         cfg = tiny_config(tmp_path, distributed="pmean", save_models=False, num_iterations=1)
         exp = GanExperiment(cfg)
