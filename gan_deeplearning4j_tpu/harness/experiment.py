@@ -808,8 +808,20 @@ class GanExperiment:
                         with self.timer.phase("export_manifold"):
                             self.export_manifold(index)
                         if eval_callback is not None:
+                            # close the throughput window BEFORE the callback
+                            # and restart it after: the eval hook is
+                            # instrumentation, not product behavior —
+                            # charging its device evals + host FID math to
+                            # the window would deflate every images_per_sec
+                            # entry sharing a flush group with a boundary.
+                            # The manifold/prediction exports stay INSIDE
+                            # the window deliberately: they are the
+                            # reference's own loop work (I15), so the
+                            # "full run loop" throughput keeps counting them
+                            flush()
                             with self.timer.phase("eval_callback"):
                                 eval_callback(self, index)
+                            window_t0 = time.perf_counter()
                     if have_predictions and self.batch_counter % cfg.save_every == 0:
                         with self.timer.phase("export_predictions"):
                             self.export_predictions(test_iterator, index)

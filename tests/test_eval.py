@@ -151,6 +151,25 @@ class TestFid:
         # bit-identical
         assert np.array_equal(feats, frozen_feature_fn(28, 28, 1, seed=666)(x))
 
+    def test_frozen_feature_forward_matches_extract(self):
+        """``extract.forward`` (the raw jittable composition hook the
+        quality-run tracker fuses with the generator) must produce the same
+        features as the batched host-side ``extract``."""
+        import jax
+        import jax.numpy as jnp
+
+        from gan_deeplearning4j_tpu.eval.fid import frozen_feature_fn
+
+        fn = frozen_feature_fn(28, 28, 1, seed=666, batch_size=3)
+        x = np.linspace(0, 1, 8 * 784, dtype=np.float32).reshape(8, 784)
+        via_forward = np.asarray(jax.jit(fn.forward)(jnp.asarray(x)))
+        np.testing.assert_allclose(via_forward, fn(x), rtol=1e-6, atol=1e-7)
+        # image-shaped input goes through the same reshape path
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(fn.forward)(jnp.asarray(x.reshape(8, 28, 28, 1)))),
+            via_forward, rtol=1e-6, atol=1e-7,
+        )
+
     def test_frozen_feature_fn_orders_models(self):
         from gan_deeplearning4j_tpu.eval.fid import frozen_feature_fn
 
