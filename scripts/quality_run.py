@@ -109,7 +109,12 @@ def main() -> int:
 
     from gan_deeplearning4j_tpu.eval.fid import FeatureStats, fid_from_stats
 
-    frozen_fn = frozen_feature_fn(cfg.height, cfg.width, cfg.channels, seed=666)
+    # large extraction chunks: each chunk is one device round trip (~0.5-1 s
+    # through the tunnel), and the FID stage moves ~110k samples — 500-sample
+    # chunks made it the slowest part of the whole run
+    frozen_fn = frozen_feature_fn(
+        cfg.height, cfg.width, cfg.channels, seed=666, batch_size=2500
+    )
 
     # Real-set feature stats under the frozen extractor: computed ONCE and
     # reused by the quick-FID tracker and both full FID@50k scores below.
@@ -221,7 +226,7 @@ def main() -> int:
     def sample_fakes(params) -> np.ndarray:
         rng = np.random.default_rng(args.seed + 7)
         fakes = []
-        bs = 1000
+        bs = 2500
         from gan_deeplearning4j_tpu.runtime.dtype import compute_dtype_scope
 
         with compute_dtype_scope(exp._compute_dtype):
@@ -239,11 +244,14 @@ def main() -> int:
 
     t0 = time.time()
     fakes = sample_fakes(exp.gen_params)
+    print(f"sampled {len(fakes)} fakes ({time.time() - t0:.0f}s)", flush=True)
     fid = frozen_fid(fakes)
+    print(f"frozen FID done ({time.time() - t0:.0f}s)", flush=True)
     dis_fn = graph_feature_fn(
-        exp.dis, exp.dis_state.params, "dis_dense_layer_6", batch_size=500
+        exp.dis, exp.dis_state.params, "dis_dense_layer_6", batch_size=2500
     )
     fid_dis = fid_score(xtr, fakes, dis_fn)
+    print(f"dis-feature FID done ({time.time() - t0:.0f}s)", flush=True)
     fid_best = None
     if not best_is_final:
         fid_best = frozen_fid(sample_fakes(best["gen_params"]))

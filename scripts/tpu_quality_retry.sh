@@ -1,17 +1,56 @@
 #!/bin/bash
-# Wait for the TPU tunnel to answer, then regenerate the full coherent
-# quality-artifact set with the selection-enabled script.
+# Wait for the TPU tunnel to answer, then regenerate (1) the coherent
+# quality-artifact set with the selection-enabled script and (2) the
+# five-config bench read against the committed BENCH_BASELINES.json so
+# artifacts/benchmarks.json carries non-null vs_baseline ratios (round-2
+# VERDICT weak #7: cross-run stability evidence).
+#
+# The two steps are independent: each is attempted whenever the probe
+# passes, succeeds only if its artifact says platform=tpu/degraded=false
+# (both tools silently fall back to CPU if the tunnel drops mid-run — a
+# CPU result must not clobber committed TPU artifacts; on contamination
+# the git version is restored), and the loop always backs off 60 s.
 cd /root/repo
+quality_done=0
+bench_done=0
 for i in $(seq 1 300); do
   echo "$(date +%H:%M:%S) probe $i" >> tpu_poller2.log
   if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
-    echo "$(date +%H:%M:%S) TPU up — quality run" >> tpu_poller2.log
-    python scripts/quality_run.py --iterations 4000 --batch 200 > quality_run.log 2>&1
-    rc=$?
-    echo "$(date +%H:%M:%S) quality rc=$rc" >> tpu_poller2.log
-    # a mid-run tunnel drop kills the script non-zero: keep polling and
-    # retry the whole run — only a completed run (rc=0) ends the loop
-    if [ "$rc" -eq 0 ]; then exit 0; fi
+    if [ "$quality_done" -eq 0 ]; then
+      echo "$(date +%H:%M:%S) TPU up — quality run" >> tpu_poller2.log
+      # remove the previous JSON first: it is written LAST by the script, so
+      # its presence with platform=tpu after the run proves THIS attempt
+      # completed (a timeout-killed attempt must not false-pass against the
+      # committed file)
+      rm -f artifacts/quality_run.json
+      timeout 2400 python scripts/quality_run.py --iterations 4000 --batch 200 > quality_run.log 2>&1
+      rc=$?
+      if [ "$rc" -eq 0 ] && python -c "import json,sys; sys.exit(0 if json.load(open('artifacts/quality_run.json'))['platform']=='tpu' else 1)" 2>/dev/null; then
+        quality_done=1
+      else
+        git checkout -- artifacts/quality_run.json artifacts/DCGAN_Generated_Images.png 2>/dev/null
+      fi
+      echo "$(date +%H:%M:%S) quality rc=$rc done=$quality_done" >> tpu_poller2.log
+    fi
+    if [ "$bench_done" -eq 0 ]; then
+      echo "$(date +%H:%M:%S) bench repeat" >> tpu_poller2.log
+      rm -f artifacts/benchmarks.json  # same completed-attempt proof as above
+      timeout 2400 python bench.py --config all --json artifacts/benchmarks.json > bench_all.log 2>&1
+      rc=$?
+      if [ "$rc" -eq 0 ] && python -c "
+import json, sys
+d = json.load(open('artifacts/benchmarks.json'))
+ok = (not d['diagnostics']['degraded']
+      and len(d['results']) == 5
+      and all('metric' in r for r in d['results']))
+sys.exit(0 if ok else 1)" 2>/dev/null; then
+        bench_done=1
+      else
+        git checkout -- artifacts/benchmarks.json 2>/dev/null
+      fi
+      echo "$(date +%H:%M:%S) bench rc=$rc done=$bench_done" >> tpu_poller2.log
+    fi
+    if [ "$quality_done" -eq 1 ] && [ "$bench_done" -eq 1 ]; then exit 0; fi
   fi
   sleep 60
 done
