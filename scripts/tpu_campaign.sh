@@ -1,18 +1,67 @@
 #!/bin/bash
-# Round-3 on-chip campaign, tunnel-outage-tolerant: waits for the TPU to
-# answer, then runs the full bench (writing BENCH_BASELINES.json) and the
-# long quality run. Safe to re-run; logs to bench_all.log / quality_run.log.
-cd /root/repo
-for i in $(seq 1 200); do
+# Round-4 on-chip campaign, tunnel-outage-tolerant: waits for the TPU to
+# answer, then (1) captures all seven bench configs and refreshes
+# BENCH_BASELINES.json, (2) re-runs the bench against those baselines so
+# artifacts/benchmarks.json carries non-null vs_baseline for every config,
+# (3) runs the long quality run. Each step validates its artifact and
+# restores the committed state on failure (ADVICE r3: a timeout-killed or
+# CPU-degraded attempt must not clobber committed TPU evidence, and the
+# restore must cover the FULL output set, not just two files).
+cd /root/repo || exit 1
+bench_done=0
+quality_done=0
+for i in $(seq 1 300); do
   echo "$(date +%H:%M:%S) probe $i" >> tpu_poller.log
   if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
-    echo "$(date +%H:%M:%S) TPU up — running campaign" >> tpu_poller.log
-    python bench.py --config all --json artifacts/benchmarks.json --update-baselines > bench_all.log 2>&1
-    echo "$(date +%H:%M:%S) bench rc=$?" >> tpu_poller.log
-    python scripts/quality_run.py --iterations 4000 --batch 200 > quality_run.log 2>&1
-    echo "$(date +%H:%M:%S) quality rc=$?" >> tpu_poller.log
-    exit 0
+    if [ "$bench_done" -eq 0 ]; then
+      echo "$(date +%H:%M:%S) TPU up — bench capture" >> tpu_poller.log
+      rm -f artifacts/benchmarks.json  # written fresh; absence after a kill is detectable
+      GDT_BENCH_BUDGET=1500 timeout 1600 python bench.py --json artifacts/benchmarks.json --update-baselines > bench_all.log 2>&1
+      rc=$?
+      # second pass rides the warm compilation cache (~seconds per config)
+      # and reads the just-refreshed baselines -> non-null vs_baseline
+      GDT_BENCH_BUDGET=900 timeout 1000 python bench.py --json artifacts/benchmarks.json > bench_all2.log 2>&1
+      rc2=$?
+      if python - <<'EOF' 2>/dev/null
+import json, sys
+d = json.load(open("artifacts/benchmarks.json"))
+rs = d["results"]
+ok = (not d["degraded"]
+      and len(rs) == 7
+      and all("error" not in r and not r.get("stale") and not r.get("skipped")
+              for r in rs)
+      and all(r.get("vs_baseline") is not None for r in rs))
+sys.exit(0 if ok else 1)
+EOF
+      then
+        bench_done=1
+      else
+        git checkout -- artifacts/benchmarks.json BENCH_BASELINES.json 2>/dev/null
+      fi
+      echo "$(date +%H:%M:%S) bench rc=$rc/$rc2 done=$bench_done" >> tpu_poller.log
+    fi
+    if [ "$quality_done" -eq 0 ]; then
+      echo "$(date +%H:%M:%S) quality run" >> tpu_poller.log
+      # quality_run.json is written LAST by the script, so its presence with
+      # platform=tpu after the run proves THIS attempt completed
+      rm -f artifacts/quality_run.json
+      timeout 2400 python scripts/quality_run.py --iterations 4000 --batch 200 > quality_run.log 2>&1
+      rc=$?
+      if [ "$rc" -eq 0 ] && python -c "import json,sys; sys.exit(0 if json.load(open('artifacts/quality_run.json'))['platform']=='tpu' else 1)" 2>/dev/null; then
+        quality_done=1
+      else
+        # restore the FULL quality output set — but ONLY the quality files:
+        # a blanket `git checkout -- artifacts/` would also revert the
+        # benchmarks.json the bench step just captured (tracked files back
+        # to HEAD; untracked leftovers — model zips, finals, manifolds —
+        # removed; git clean never touches tracked benchmarks.json)
+        git checkout -- artifacts/quality_run.json artifacts/DCGAN_Generated_Images.png 2>/dev/null
+        git clean -fdq artifacts/ 2>/dev/null
+      fi
+      echo "$(date +%H:%M:%S) quality rc=$rc done=$quality_done" >> tpu_poller.log
+    fi
+    if [ "$bench_done" -eq 1 ] && [ "$quality_done" -eq 1 ]; then exit 0; fi
   fi
-  sleep 100
+  sleep 60
 done
 echo "$(date +%H:%M:%S) gave up" >> tpu_poller.log

@@ -12,6 +12,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Opt the suite back into the persistent compilation cache: CPU persistence
+# is off by default (cpu_aot_loader noise / cross-host SIGILL risk in
+# driver-facing tails — runtime/environment.py), but for tests the warm
+# cache saves minutes and the load warnings only reach pytest's captured
+# output. The per-host tag inside keeps entries host-compatible.
+os.environ.setdefault(
+    "GDT_COMPILATION_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
 
 import jax  # noqa: E402
 
