@@ -123,6 +123,19 @@ class GanExperiment:
         else:
             self.cv_trainer, self.cv_state = None, None
         self.gen_params = self.gen.init()
+        # bf16 param storage (round-4 VERDICT item 3): cast every float leaf
+        # of params + updater state once at init; all jitted programs then
+        # carry bf16 state end to end — half the HBM bytes per step on a
+        # workload whose roofline is bandwidth-bound. Init/serialization stay
+        # f32-defined (cast on entry, dtype-tagged in checkpoints).
+        self._param_dtype = parse_compute_dtype(cfg.param_dtype)
+        if self._param_dtype is not None:
+            cast = self._cast_state
+            self.dis_state = cast(self.dis_state)
+            self.gan_state = cast(self.gan_state)
+            if self.cv_state is not None:
+                self.cv_state = cast(self.cv_state)
+            self.gen_params = cast(self.gen_params)
         self._gen_fwd = jax.jit(lambda p, z: self.gen.output(p, z, train=False))
 
         # label-softening noise, sampled ONCE like the reference (:404-406)
@@ -159,6 +172,13 @@ class GanExperiment:
         # the scan-of-K device loop, built lazily on first train_iterations
         self._fused_multi = None
         self._supports_device_loop = self._fused is not None
+        if self._fused is None and cfg.distributed == "param_averaging" \
+                and self.mesh is not None:
+            # faithful-averaging mode gets its own device loop (round-4
+            # VERDICT item 5): the scanned shard_map program below feeds
+            # _build_multi_iteration in place of the fused GraphTrainer body
+            self._fused_body = self._build_fused_avg_body()
+            self._supports_device_loop = True
 
     # ------------------------------------------------------------------
     def _make_trainer(self, graph: ComputationGraph):
@@ -172,6 +192,18 @@ class GanExperiment:
             )
         mesh = self.mesh if cfg.distributed == "pmean" else None
         return GraphTrainer(graph, mesh=mesh)
+
+    def _cast_state(self, state):
+        """Cast every floating leaf of a TrainState / params tree to the
+        param storage dtype (ints — step counters, Adam's t — stay)."""
+
+        def leaf(x):
+            x = jnp.asarray(x)
+            return x.astype(self._param_dtype) if jnp.issubdtype(
+                x.dtype, jnp.floating
+            ) else x
+
+        return jax.tree_util.tree_map(leaf, state)
 
     def _soft_noise(self, n: int) -> np.ndarray:
         return (
@@ -287,6 +319,126 @@ class GanExperiment:
         self._fused_body = fused
         return jax.jit(fused, **kwargs)
 
+    def _build_fused_avg_body(self):
+        """The alternating iteration under FAITHFUL parameter averaging as
+        one shard_map program (round-4 VERDICT item 5).
+
+        Semantics: per-fit averaging rounds — each graph's fit is one local
+        optimizer step per worker on its shard of the batch (two sequential
+        steps for the discriminator's real-then-fake pair), followed by an
+        arithmetic mean of params AND updater state across the mesh. This is
+        the cadence the reference's loop actually exercises: every
+        ``sparkGraph.fit`` call per iteration carries fewer minibatches than
+        ``averagingFrequency(10)`` (the dis fit has 2, the gan/cv fits 1 —
+        dl4jGANComputerVision.java:414-421,462-471,544-545), and DL4J
+        averages at the fit boundary regardless, so averaging happens once
+        per fit — exactly what this program does, minus the Spark
+        serialization. The k-step ``averaging_frequency`` semantics remain
+        fully exercised on the trainer surface
+        (``ParameterAveragingTrainer.fit/fit_round/fit_rounds``).
+
+        Differences from the phased path (``_train_iteration``'s
+        ``trainer.fit`` route, still used for single dispatches): worker-local
+        RNG draws derive from the step counter + ``axis_index`` (no host
+        round trip), and each worker sees a contiguous shard of both the real
+        and fake minibatches rather than the phased path's worker-major
+        regrouping. Both are documented DL4J-analog layouts; losses are
+        cross-worker means either way."""
+        from jax import shard_map as _shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from gan_deeplearning4j_tpu.parallel.param_averaging import _average_tree
+
+        axis = "data"
+        gen_graph = self.gen
+        z_size = self.model_cfg.z_size
+        base_key = jax.random.PRNGKey(self.config.seed + 2)
+
+        def one_step(graph, opt, state: TrainState, feats, labels, key):
+            def loss_fn(p):
+                loss, (_, new_p) = graph.loss(p, feats, labels, train=True, rng=key)
+                return loss, new_p
+
+            (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            params, opt_state = opt.step(new_params, grads, state.opt_state)
+            return TrainState(params, opt_state, state.step + 1), loss
+
+        def avg(state: TrainState) -> TrainState:
+            return TrainState(
+                _average_tree(state.params, axis),
+                _average_tree(state.opt_state, axis),
+                state.step,
+            )
+
+        def rebind(src: TrainState, dst: TrainState, mapping) -> TrainState:
+            return TrainState(
+                ComputationGraph.copy_params(src.params, dst.params, mapping),
+                dst.opt_state,
+                dst.step,
+            )
+
+        def body(dis_state, gan_state, cv_state, gen_params,
+                 real_f, real_l, soft1, soft0):
+            widx = jax.lax.axis_index(axis)
+            b = real_f.shape[0]  # per-worker rows
+            key = jax.random.fold_in(base_key, dis_state.step)
+            k_fake, k_gan, k_d1, k_d2, k_g, k_c = jax.random.split(key, 6)
+
+            def wkey(k):  # worker-distinct subkey for local draws/dropout
+                return jax.random.fold_in(k, widx)
+
+            z_fake = jax.random.uniform(
+                wkey(k_fake), (b, z_size), jnp.float32, -1.0, 1.0
+            )
+            fake = gen_graph.output(gen_params, z_fake, train=False)
+            fake = fake.reshape(real_f.shape)
+            # dis "fit": two local steps (real→soft1, fake→soft0) then ONE
+            # average — the 2-element-List<DataSet> fit boundary
+            dis_state, d1 = one_step(
+                self.dis, self.dis_trainer.optimizer, dis_state,
+                real_f, soft1, wkey(k_d1),
+            )
+            dis_state, d2 = one_step(
+                self.dis, self.dis_trainer.optimizer, dis_state,
+                fake, soft0, wkey(k_d2),
+            )
+            dis_state = avg(dis_state)
+            gan_state = rebind(dis_state, gan_state, self.dis_to_gan)
+            z_gan = jax.random.uniform(
+                wkey(k_gan), (b, z_size), jnp.float32, -1.0, 1.0
+            )
+            ones = jnp.ones((b, 1), jnp.float32)
+            gan_state, g = one_step(
+                self.gan, self.gan_trainer.optimizer, gan_state,
+                z_gan, ones, wkey(k_g),
+            )
+            gan_state = avg(gan_state)
+            gen_params = ComputationGraph.copy_params(
+                gan_state.params, gen_params, self.gan_to_gen
+            )
+            if self.cv is not None:
+                cv_state = rebind(dis_state, cv_state, self.family.dis_to_cv)
+                cv_state, c = one_step(
+                    self.cv, self.cv_trainer.optimizer, cv_state,
+                    real_f, real_l, wkey(k_c),
+                )
+                cv_state = avg(cv_state)
+                c = jax.lax.pmean(c, axis)
+            else:
+                c = jnp.float32(jnp.nan)
+            d = jax.lax.pmean((d1 + d2) / 2.0, axis)
+            g = jax.lax.pmean(g, axis)
+            return dis_state, gan_state, cv_state, gen_params, d, g, c
+
+        return _shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(), P(axis), P(axis), P(axis), P(axis)),
+            out_specs=(P(),) * 7,
+        )
+
     def _build_multi_iteration(self):
         """The DEVICE-SIDE training loop: ``lax.scan`` of the fused iteration
         over a (K, B, …) window of batches — K full alternating iterations
@@ -358,13 +510,16 @@ class GanExperiment:
         exactly like the per-dispatch path). Returns (K,)-shaped DEVICE loss
         arrays (no sync; fetch when needed).
 
-        Unavailable in parameter-averaging mode (its fit has its own
-        shard_map program) and with ``resample_label_noise`` (the window
-        shares the once-sampled noise — which is the reference's semantics)."""
-        if self._fused is None:
+        In parameter-averaging mode the scanned body is the shard_map
+        per-fit-averaging program (``_build_fused_avg_body``) instead of the
+        fused GraphTrainer body — same window contract, faithful averaging
+        semantics. Unavailable with ``resample_label_noise`` (the window
+        shares the once-sampled noise — which is the reference's semantics)
+        and in averaging mode without a mesh."""
+        if not getattr(self, "_supports_device_loop", False):
             raise ValueError(
-                "train_iterations requires the fused path "
-                "(single-chip or per-step pmean; not param_averaging)"
+                "train_iterations requires the fused path (single-chip, "
+                "per-step pmean, or param_averaging on a mesh)"
             )
         if self.config.resample_label_noise:
             raise ValueError(
@@ -619,18 +774,26 @@ class GanExperiment:
                 return jax.device_put(state, NamedSharding(self.mesh, PartitionSpec()))
             return state
 
-        self.dis_state = _placed(
+        def _stored(state):
+            # checkpoints written under bf16 storage restore as bf16 already
+            # (dtype-tagged); an f32 checkpoint resumed under param_dtype=bf16
+            # gets cast on entry, mirroring __init__
+            if self._param_dtype is not None:
+                state = self._cast_state(state)
+            return _placed(state)
+
+        self.dis_state = _stored(
             ModelSerializer.restore_train_state(f"{prefix}_dis_model.zip", self.dis_trainer)
         )
-        self.gan_state = _placed(
+        self.gan_state = _stored(
             ModelSerializer.restore_train_state(f"{prefix}_gan_model.zip", self.gan_trainer)
         )
         if self.cv is not None:
-            self.cv_state = _placed(
+            self.cv_state = _stored(
                 ModelSerializer.restore_train_state(f"{prefix}_CV_model.zip", self.cv_trainer)
             )
         _, gen_params, _, _ = read_model(f"{prefix}_gen_model.zip", load_updater=False)
-        self.gen_params = _placed(gen_params)
+        self.gen_params = _stored(gen_params)
         # the gan graph steps once per loop iteration — use it as the counter
         self.batch_counter = int(self.gan_state.step)
         return self.batch_counter
@@ -804,27 +967,29 @@ class GanExperiment:
                 # the window's last element, whose state is current now)
                 for _ in range(n_window):
                     index = self.batch_counter + 1
-                    if self.batch_counter % cfg.print_every == 0:
+                    at_print = self.batch_counter % cfg.print_every == 0
+                    if at_print:
                         with self.timer.phase("export_manifold"):
                             self.export_manifold(index)
-                        if eval_callback is not None:
-                            # close the throughput window BEFORE the callback
-                            # and restart it after: the eval hook is
-                            # instrumentation, not product behavior —
-                            # charging its device evals + host FID math to
-                            # the window would deflate every images_per_sec
-                            # entry sharing a flush group with a boundary.
-                            # The manifold/prediction exports stay INSIDE
-                            # the window deliberately: they are the
-                            # reference's own loop work (I15), so the
-                            # "full run loop" throughput keeps counting them
-                            flush()
-                            with self.timer.phase("eval_callback"):
-                                eval_callback(self, index)
-                            window_t0 = time.perf_counter()
                     if have_predictions and self.batch_counter % cfg.save_every == 0:
                         with self.timer.phase("export_predictions"):
                             self.export_predictions(test_iterator, index)
+                    if at_print and eval_callback is not None:
+                        # close the throughput window BEFORE the callback and
+                        # restart it after: the eval hook is instrumentation,
+                        # not product behavior — charging its device evals +
+                        # host FID math to the window would deflate every
+                        # images_per_sec entry sharing a flush group with a
+                        # boundary. The manifold/prediction exports stay
+                        # INSIDE the window deliberately (both run above,
+                        # before this flush, even when print/save boundaries
+                        # coincide — ADVICE r3): they are the reference's own
+                        # loop work (I15), so the "full run loop" throughput
+                        # keeps counting them.
+                        flush()
+                        with self.timer.phase("eval_callback"):
+                            eval_callback(self, index)
+                        window_t0 = time.perf_counter()
                     if cfg.save_models:
                         with self.timer.phase("checkpoint"):
                             self.save_models()

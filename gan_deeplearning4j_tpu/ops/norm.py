@@ -38,8 +38,11 @@ def batch_norm_train(
     var = jnp.var(x, axis=axes)
     inv = jnp.reciprocal(jnp.sqrt(var + eps))
     y = (x - mean) * inv * gamma + beta
-    new_mean = decay * running_mean + (1.0 - decay) * mean
-    new_var = decay * running_var + (1.0 - decay) * var
+    # accumulate in the promoted dtype, store back in the stats' own dtype:
+    # params must be dtype-stable through a train step or bf16 param storage
+    # would silently upcast the state tree after one iteration
+    new_mean = (decay * running_mean + (1.0 - decay) * mean).astype(running_mean.dtype)
+    new_var = (decay * running_var + (1.0 - decay) * var).astype(running_var.dtype)
     return y, new_mean, new_var
 
 

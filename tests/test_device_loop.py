@@ -220,6 +220,94 @@ class TestWganDeviceLoop:
         assert "train_rounds" in out["timings"]
 
 
+class TestParamAveragingDeviceLoop:
+    """The faithful-averaging mode's scan window (round-4 VERDICT item 5):
+    ``train_iterations`` under ``distributed="param_averaging"`` scans the
+    shard_map per-fit-averaging body."""
+
+    def _exp(self, **kw):
+        from gan_deeplearning4j_tpu.harness import make_experiment
+        from gan_deeplearning4j_tpu.runtime import TpuEnvironment
+
+        mesh = TpuEnvironment().make_mesh()
+        base = dict(
+            batch_size_train=16, batch_size_pred=16, num_iterations=10 ** 9,
+            save_models=False, distributed="param_averaging",
+        )
+        base.update(kw)
+        return make_experiment(ExperimentConfig(**base), mesh=mesh), mesh
+
+    @pytest.mark.slow
+    def test_scan_window_runs_and_replicates(self):
+        exp, mesh = self._exp()
+        assert exp._supports_device_loop
+        rng = np.random.default_rng(2)
+        feats = rng.random((2, 16, 784), dtype=np.float32)
+        labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, (2, 16))]
+        out = exp.train_iterations(feats, labels)
+        assert out["d_loss"].shape == (2,)
+        for k in ("d_loss", "g_loss", "cv_loss"):
+            assert np.isfinite(np.asarray(out[k])).all()
+        # post-averaging invariant: every device's replica is bit-identical
+        # for params AND updater state (the reference averages both, D16)
+        for state in (exp.dis_state, exp.gan_state, exp.cv_state):
+            for leaf in jax.tree_util.tree_leaves((state.params, state.opt_state)):
+                shards = getattr(leaf, "addressable_shards", None)
+                if not shards or len(shards) < 2:
+                    continue
+                first = np.asarray(shards[0].data)
+                for s in shards[1:]:
+                    np.testing.assert_array_equal(first, np.asarray(s.data))
+        assert int(exp.dis_state.step) == 4  # 2 iterations x 2 dis steps
+
+    @pytest.mark.slow
+    def test_scan_chunks_compose(self):
+        """scan(K=2) == scan(K=1);scan(K=1) — same program, carried state;
+        the per-step RNG derives from the step counter, so chunking cannot
+        change the math."""
+        rng = np.random.default_rng(3)
+        feats = rng.random((2, 16, 784), dtype=np.float32)
+        labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, (2, 16))]
+
+        one, _ = self._exp()
+        l0 = one.train_iterations(feats[:1], labels[:1])
+        l1 = one.train_iterations(feats[1:], labels[1:])
+        two, _ = self._exp()
+        l01 = two.train_iterations(feats, labels)
+        np.testing.assert_allclose(
+            np.asarray(l01["d_loss"]),
+            [float(l0["d_loss"][0]), float(l1["d_loss"][0])],
+            rtol=2e-5, atol=1e-6,
+        )
+        for a, e in zip(
+            jax.tree_util.tree_leaves(two.dis_state.params),
+            jax.tree_util.tree_leaves(one.dis_state.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=0, atol=2e-3
+            )
+
+    @pytest.mark.slow
+    def test_averaging_loop_differs_from_pmean_loop(self):
+        """The faithful mode is a different algorithm from per-step gradient
+        sync (SURVEY §7): local steps diverge before the average."""
+        rng = np.random.default_rng(4)
+        feats = rng.random((2, 16, 784), dtype=np.float32)
+        labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, (2, 16))]
+        avg, _ = self._exp()
+        avg.train_iterations(feats, labels)
+        pm, _ = self._exp(distributed="pmean")
+        pm.train_iterations(feats, labels)
+        diffs = [
+            float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(avg.dis_state.params),
+                jax.tree_util.tree_leaves(pm.dis_state.params),
+            )
+        ]
+        assert max(diffs) > 1e-6
+
+
 class TestDeviceResidentIterator:
     def test_batches_are_device_arrays_and_cover_data(self):
         feats = np.arange(20 * 4, dtype=np.float32).reshape(20, 4) / 80.0

@@ -4,6 +4,7 @@ exports, checkpoints), on the CPU fake mesh."""
 
 import os
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -188,6 +189,56 @@ class TestExperimentLoop:
     def test_bad_compute_dtype_rejected(self):
         with pytest.raises(ValueError):
             ExperimentConfig(compute_dtype="fp8").validate()
+
+    @pytest.mark.slow
+    def test_bf16_param_storage(self, tmp_path):
+        """param_dtype="bf16" (round-4 VERDICT item 3): params AND updater
+        state live in bfloat16 end to end — the pure-bf16 storage mode for
+        the bandwidth-bound regime — and training still converges."""
+        import jax
+
+        cfg = tiny_config(tmp_path, save_models=False, param_dtype="bf16")
+        assert cfg.compute_dtype == "bf16"  # storage implies compute
+        exp = GanExperiment(cfg)
+        for state in (exp.dis_state, exp.gan_state, exp.cv_state):
+            for leaf in jax.tree_util.tree_leaves((state.params, state.opt_state)):
+                if jnp.issubdtype(leaf.dtype, jnp.floating):
+                    assert leaf.dtype == jnp.bfloat16
+                else:
+                    assert leaf.dtype == jnp.int32  # step counters stay int
+        x, y = _one_batch()
+        first = None
+        for _ in range(6):
+            losses = exp.train_iteration(x, y)
+            if first is None:
+                first = float(losses["cv_loss"])
+        # params stay bf16 THROUGH the jitted step (no silent f32 upcast)
+        for leaf in jax.tree_util.tree_leaves(exp.dis_state.params):
+            assert leaf.dtype == jnp.bfloat16
+        assert np.isfinite(float(losses["d_loss"]))
+        # same batch 6x: the classifier must learn it (convergence guard)
+        assert float(losses["cv_loss"]) < first
+
+    @pytest.mark.slow
+    def test_bf16_param_storage_checkpoint_roundtrip(self, tmp_path):
+        """Save/resume under bf16 storage: dtype survives the zip round trip
+        (npz stores bf16 as tagged uint16 bit patterns)."""
+        import jax
+
+        cfg = tiny_config(tmp_path, param_dtype="bf16", save_models=True,
+                          num_iterations=1)
+        exp = GanExperiment(cfg)
+        x, y = _one_batch()
+        exp.train_iteration(x, y)
+        exp.save_models()
+        exp2 = GanExperiment(cfg)
+        exp2.load_models()
+        for a, b in zip(
+            jax.tree_util.tree_leaves(exp.dis_state.params),
+            jax.tree_util.tree_leaves(exp2.dis_state.params),
+        ):
+            assert b.dtype == a.dtype == jnp.bfloat16
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     @pytest.mark.slow
     def test_eval_callback_fires_at_export_boundaries(self, tmp_path):

@@ -233,3 +233,59 @@ class TestParameterAveraging:
         x, y = toy_data(17)
         with pytest.raises(ValueError):
             pa.fit_round(pa.init_state(), jnp.asarray(x), jnp.asarray(y))
+
+    def test_fit_rounds_matches_sequential_rounds(self):
+        """K scanned averaging rounds in one dispatch == K sequential
+        fit_round calls chained through the same split(rng) sequence (the
+        round-4 device loop for the faithful mode, VERDICT r3 item 5)."""
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        W, freq, b, K = 8, 2, 4, 3
+        pa = ParameterAveragingTrainer(
+            graph, mesh, batch_size_per_worker=b, averaging_frequency=freq
+        )
+        x, y = toy_data(K * W * freq * b, seed=5)
+        xs = jnp.asarray(x.reshape(K, W * freq * b, -1))
+        ys = jnp.asarray(y.reshape(K, W * freq * b, -1))
+        rng = jax.random.PRNGKey(123)
+
+        s_scan, losses_scan = pa.fit_rounds(pa.init_state(), xs, ys, rng=rng)
+        assert losses_scan.shape == (K, freq)
+        assert int(s_scan.step) == K * freq
+
+        s_seq = pa.init_state()
+        r = rng
+        seq_losses = []
+        for i in range(K):
+            r, sub = jax.random.split(r)
+            s_seq, l = pa.fit_round(s_seq, xs[i], ys[i], rng=sub)
+            seq_losses.append(np.asarray(l))
+        np.testing.assert_allclose(
+            np.asarray(losses_scan), np.stack(seq_losses), rtol=2e-5, atol=1e-6
+        )
+        jax.tree_util.tree_map(
+            lambda a, e: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5
+            ),
+            s_scan.params,
+            s_seq.params,
+        )
+        jax.tree_util.tree_map(
+            lambda a, e: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5
+            ),
+            s_scan.opt_state,
+            s_seq.opt_state,
+        )
+
+    def test_fit_rounds_bad_shape_raises(self):
+        graph = small_classifier()
+        mesh = TpuEnvironment().make_mesh()
+        pa = ParameterAveragingTrainer(graph, mesh, batch_size_per_worker=4, averaging_frequency=2)
+        x, y = toy_data(2 * 60)
+        with pytest.raises(ValueError):
+            pa.fit_rounds(
+                pa.init_state(),
+                jnp.asarray(x.reshape(2, 60, -1)),
+                jnp.asarray(y.reshape(2, 60, -1)),
+            )

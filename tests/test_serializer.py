@@ -52,6 +52,33 @@ class TestSerializer:
         assert_trees_equal(s1.params, s2.params)
         assert int(s2.step) == 1
 
+    def test_bf16_arrays_round_trip_with_dtype(self, tmp_path):
+        """bf16 param storage (round-4): npz can't hold ml_dtypes extension
+        types, so bf16 leaves travel as tagged uint16 bit patterns and must
+        come back BIT-identical with the right dtype."""
+        gen = build_generator()
+        trainer = GraphTrainer(gen)
+        state = trainer.init_state()
+        bf16 = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            state,
+        )
+        path = os.path.join(tmp_path, "bf16.zip")
+        write_model(path, gen, bf16, save_updater=True)
+        _, params, opt_state, _ = read_model(path)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(bf16.params), jax.tree_util.tree_leaves(params)
+        ):
+            assert b.dtype == a.dtype == jnp.bfloat16
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(bf16.opt_state),
+            jax.tree_util.tree_leaves(opt_state),
+        ):
+            assert b.dtype == a.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_save_without_updater(self, tmp_path):
         gen = build_generator()
         params = gen.init()
