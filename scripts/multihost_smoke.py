@@ -151,6 +151,39 @@ def main() -> int:
         f"checksum={checksum(pa_state.params)}",
         flush=True,
     )
+
+    # -- 3. WGAN-GP round (scanned grad-of-grad critic + generator step) ----
+    # (round-4 VERDICT item 8: the WGAN mode joins the cross-process smoke)
+    from gan_deeplearning4j_tpu.models.wgan_gp import WganGpConfig, WganGpTrainer
+
+    wcfg = WganGpConfig(
+        height=8, width=8, channels=1, z_size=4, base_filters=8,
+        dense_width=32, n_critic=2, seed=666,
+    )
+    wtr = WganGpTrainer(wcfg, mesh=mesh)
+    critic_state, gen_state = wtr.init_states(seed=0)
+    rngw = np.random.default_rng(3)
+    rows_global = n_global  # one row per device per critic minibatch
+    rows_local = rows_global // jax.process_count()
+    lo = args.process_id * rows_local
+    real_global = rngw.random(
+        (wcfg.n_critic, rows_global, wcfg.num_features), dtype=np.float32
+    )
+    batches_sharding = NamedSharding(mesh, P(None, "data"))
+    real_batches = jax.make_array_from_process_local_data(
+        batches_sharding, real_global[:, lo : lo + rows_local]
+    )
+    critic_state, gen_state, c_loss, g_loss = wtr.train_round(
+        critic_state, gen_state, real_batches, jax.random.PRNGKey(7)
+    )
+    assert_local_replicas_equal(critic_state.params, "wgan critic params")
+    assert_local_replicas_equal(gen_state.params, "wgan gen params")
+    print(
+        f"[multihost] mode=wgan_gp c_loss={float(c_loss):.6f} "
+        f"g_loss={float(g_loss):.6f} "
+        f"checksum={checksum((critic_state.params, gen_state.params))}",
+        flush=True,
+    )
     print(f"[multihost] process {args.process_id} OK", flush=True)
     return 0
 

@@ -1,17 +1,18 @@
-"""Multi-host coordination smoke (round-2 VERDICT missing #4 / next #5).
+"""Multi-host coordination smoke (round-2 VERDICT missing #4; round-4
+VERDICT item 8 scales it to 4 processes and adds the WGAN-GP mode).
 
-Spawns TWO real OS processes that meet at a jax.distributed coordinator and
+Spawns N real OS processes that meet at a jax.distributed coordinator and
 form one global mesh — the cross-process analog of the reference's
 multi-JVM Spark architecture (dl4jGANComputerVision.java:317-330). Each
-process runs one pmean step and one parameter-averaging round on
-process-locally-fed global batches and prints a params checksum; this test
-asserts the processes END UP BIT-IDENTICAL (same checksums), i.e. the
-collectives really synchronized state across process boundaries.
+process runs one pmean step, one parameter-averaging round, and one WGAN-GP
+round on process-locally-fed global batches and prints a params checksum per
+mode; this test asserts the processes END UP BIT-IDENTICAL (same checksums),
+i.e. the collectives really synchronized state across process boundaries.
 
 The spawn/drain/validate plumbing lives in ``__graft_entry__.spawn_multihost``
 (shared with ``dryrun_multihost`` so the two cannot drift).
 
-Marked slow: two cold jax processes cost ~30-60 s.
+Marked slow: N cold jax processes cost ~30-90 s.
 """
 
 import os
@@ -26,7 +27,11 @@ from __graft_entry__ import spawn_multihost  # noqa: E402
 
 
 @pytest.mark.slow
-def test_two_process_distributed_training_agrees():
-    checksums = spawn_multihost(2)
-    assert len(checksums) == 2
-    assert checksums[0] == checksums[1], f"cross-process divergence: {checksums}"
+@pytest.mark.parametrize("n_processes", [2, 4])
+def test_distributed_training_agrees_across_processes(n_processes):
+    checksums = spawn_multihost(n_processes)
+    assert len(checksums) == n_processes
+    assert all(len(c) == 3 for c in checksums)  # pmean, param_averaging, wgan
+    assert all(
+        c == checksums[0] for c in checksums[1:]
+    ), f"cross-process divergence: {checksums}"
