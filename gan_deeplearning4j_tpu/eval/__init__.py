@@ -13,6 +13,7 @@ from gan_deeplearning4j_tpu.eval.fid import (
     fid_score,
     frozen_feature_fn,
     graph_feature_fn,
+    inception_feature_fn,
 )
 from gan_deeplearning4j_tpu.eval.images import render_manifold, tile_images, write_png
 
@@ -24,6 +25,7 @@ __all__ = [
     "fid_from_stats",
     "fid_score",
     "frozen_feature_fn",
+    "inception_feature_fn",
     "graph_feature_fn",
     "render_manifold",
     "tile_images",

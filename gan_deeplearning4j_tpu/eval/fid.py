@@ -140,6 +140,132 @@ def frozen_feature_fn(
     return extract
 
 
+def inception_feature_fn(
+    height: int,
+    width: int,
+    channels: int = 1,
+    path: Optional[str] = None,
+    batch_size: int = 500,
+    seed: int = 666,
+) -> Callable:
+    """Literature-comparable FID extractor from USER-SUPPLIED weights
+    (round-4 VERDICT item 7): loads a feature network from ``path`` or
+    ``$INCEPTION_WEIGHTS`` and returns an extractor with the same call
+    contract as :func:`frozen_feature_fn`. With no weights available it
+    FALLS BACK to the frozen extractor (logged via the returned function's
+    ``.source`` attribute: ``"inception:<path>"`` or ``"frozen"``) — this
+    environment has no egress, so the canonical InceptionV3 pool3 weights
+    cannot be fetched, only mounted.
+
+    Weight format — one ``.npz`` with a ``__schema__`` JSON entry describing
+    a small dataflow graph over the remaining arrays (expressive enough for
+    InceptionV3's branched topology, not just sequential stacks):
+
+    .. code-block:: python
+
+        {"input":  {"height": 299, "width": 299, "channels": 3,
+                    "mean": [...], "std": [...]},      # optional normalize
+         "nodes": [{"name": "c1", "op": "conv", "in": "input",
+                    "stride": 2, "padding": "VALID", "activation": "relu",
+                    "kernel": "c1/kernel", "bias": "c1/bias"},  # HWIO
+                   {"name": "p1", "op": "maxpool", "in": "c1",
+                    "size": 3, "stride": 2, "padding": "VALID"},
+                   {"name": "b",  "op": "concat", "in": ["c1", "p1"]},
+                   {"name": "f",  "op": "global_avgpool", "in": "b"}],
+         "output": "f"}
+
+    Ops: ``conv`` (+optional bias/relu), ``maxpool``, ``avgpool``,
+    ``concat`` (channel axis), ``global_avgpool``. Inputs are resized to the
+    schema's spatial size (bilinear, matching the standard FID preprocessing
+    pipeline) and grayscale is broadcast to the schema's channel count."""
+    import json
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    path = path or os.environ.get("INCEPTION_WEIGHTS")
+    if not path or not os.path.exists(path):
+        fallback = frozen_feature_fn(
+            height, width, channels, seed=seed, batch_size=batch_size
+        )
+        fallback.source = "frozen"
+        return fallback
+
+    with np.load(path, allow_pickle=False) as npz:
+        schema = json.loads(str(npz["__schema__"]))
+        arrays = {k: np.asarray(npz[k]) for k in npz.files if k != "__schema__"}
+
+    spec_in = schema["input"]
+    nodes = schema["nodes"]
+    out_name = schema["output"]
+    mean = jnp.asarray(spec_in.get("mean", [0.0]), jnp.float32)
+    std = jnp.asarray(spec_in.get("std", [1.0]), jnp.float32)
+    h_in, w_in, c_in = spec_in["height"], spec_in["width"], spec_in["channels"]
+    consts = {k: jnp.asarray(v, jnp.float32) for k, v in arrays.items()}
+
+    def forward(x):
+        x = x.reshape(x.shape[0], height, width, channels).astype(jnp.float32)
+        if channels == 1 and c_in > 1:
+            x = jnp.broadcast_to(x, x.shape[:3] + (c_in,))
+        if (height, width) != (h_in, w_in):
+            x = jax.image.resize(
+                x, (x.shape[0], h_in, w_in, x.shape[3]), method="bilinear"
+            )
+        x = (x - mean) / std
+        acts = {"input": x}
+        for node in nodes:
+            op = node["op"]
+            src = node["in"]
+            if op == "concat":
+                y = jnp.concatenate([acts[s] for s in src], axis=-1)
+            else:
+                y = acts[src]
+                if op == "conv":
+                    s = node.get("stride", 1)
+                    y = jax.lax.conv_general_dilated(
+                        y, consts[node["kernel"]], (s, s),
+                        node.get("padding", "SAME"),
+                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                        precision=jax.lax.Precision.HIGHEST,
+                    )
+                    if node.get("bias"):
+                        y = y + consts[node["bias"]]
+                    if node.get("activation") == "relu":
+                        y = jnp.maximum(y, 0.0)
+                elif op in ("maxpool", "avgpool"):
+                    k, s = node["size"], node.get("stride", 1)
+                    pad = node.get("padding", "VALID")
+                    init, fn = (
+                        (-jnp.inf, jax.lax.max) if op == "maxpool"
+                        else (0.0, jax.lax.add)
+                    )
+                    y = jax.lax.reduce_window(
+                        y, init, fn, (1, k, k, 1), (1, s, s, 1), pad
+                    )
+                    if op == "avgpool":
+                        y = y / (k * k)
+                elif op == "global_avgpool":
+                    y = y.mean(axis=(1, 2))
+                else:
+                    raise ValueError(f"unknown op {op!r} in {path}")
+            acts[node["name"]] = y
+        out = acts[out_name]
+        return out.reshape(out.shape[0], -1)
+
+    fwd = jax.jit(forward)
+
+    def extract(samples: np.ndarray) -> np.ndarray:
+        chunks = []
+        for i in range(0, len(samples), batch_size):
+            chunks.append(np.asarray(fwd(jnp.asarray(samples[i : i + batch_size]))))
+        return np.concatenate(chunks, axis=0)
+
+    extract.forward = forward
+    extract.source = f"inception:{path}"
+    return extract
+
+
 def graph_feature_fn(graph, params, layer_name: str, batch_size: int = 500) -> Callable:
     """Feature extractor tapping ``layer_name``'s activation of a framework
     graph (ComputationGraph.feed_forward), batched on device."""

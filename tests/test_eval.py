@@ -195,3 +195,80 @@ class TestFid:
         fake = rng.random((32, 784), dtype=np.float32)
         fid = fid_score(real, fake, feature_fn=extract)
         assert np.isfinite(fid) and fid >= 0.0
+
+
+class TestInceptionHook:
+    """inception_feature_fn (round-4 VERDICT item 7): user-supplied weights
+    via $INCEPTION_WEIGHTS, frozen-extractor fallback, branched topology."""
+
+    @staticmethod
+    def _tiny_weights(path):
+        """A tiny branched feature net in the documented npz schema: conv →
+        {1x1 branch, maxpool branch} → concat → global_avgpool (the minimal
+        shape of an Inception block)."""
+        import json
+
+        rng = np.random.default_rng(0)
+        schema = {
+            "input": {"height": 16, "width": 16, "channels": 3,
+                      "mean": [0.5, 0.5, 0.5], "std": [0.5, 0.5, 0.5]},
+            "nodes": [
+                {"name": "c1", "op": "conv", "in": "input", "stride": 2,
+                 "padding": "SAME", "activation": "relu",
+                 "kernel": "c1/kernel", "bias": "c1/bias"},
+                {"name": "b1", "op": "conv", "in": "c1", "stride": 1,
+                 "padding": "SAME", "activation": "relu",
+                 "kernel": "b1/kernel"},
+                {"name": "b2", "op": "maxpool", "in": "c1", "size": 3,
+                 "stride": 1, "padding": "SAME"},
+                {"name": "cat", "op": "concat", "in": ["b1", "b2"]},
+                {"name": "feat", "op": "global_avgpool", "in": "cat"},
+            ],
+            "output": "feat",
+        }
+        np.savez(
+            path,
+            __schema__=json.dumps(schema),
+            **{
+                "c1/kernel": rng.normal(size=(3, 3, 3, 4)).astype(np.float32) * 0.2,
+                "c1/bias": rng.normal(size=(4,)).astype(np.float32) * 0.1,
+                "b1/kernel": rng.normal(size=(1, 1, 4, 6)).astype(np.float32) * 0.2,
+            },
+        )
+
+    def test_loads_weights_and_scores(self, tmp_path):
+        from gan_deeplearning4j_tpu.eval import inception_feature_fn
+
+        wpath = str(tmp_path / "tiny_inception.npz")
+        self._tiny_weights(wpath)
+        extract = inception_feature_fn(8, 8, 1, path=wpath, batch_size=8)
+        assert extract.source == f"inception:{wpath}"
+        rng = np.random.default_rng(1)
+        x = rng.random((12, 64), dtype=np.float32)
+        feats = extract(x)
+        assert feats.shape == (12, 10)  # 6 conv + 4 pool channels
+        assert np.isfinite(feats).all()
+        # deterministic, and grayscale input broadcast + resize engaged
+        np.testing.assert_array_equal(extract(x), feats)
+        fid = fid_score(
+            rng.random((32, 64), dtype=np.float32),
+            rng.random((32, 64), dtype=np.float32),
+            feature_fn=extract,
+        )
+        assert np.isfinite(fid) and fid >= 0.0
+
+    def test_env_var_and_fallback(self, tmp_path, monkeypatch):
+        from gan_deeplearning4j_tpu.eval import inception_feature_fn
+
+        # no path, no env: frozen fallback with the same call contract
+        monkeypatch.delenv("INCEPTION_WEIGHTS", raising=False)
+        fb = inception_feature_fn(8, 8, 1, batch_size=8)
+        assert fb.source == "frozen"
+        assert fb(np.random.default_rng(2).random((4, 64), dtype=np.float32)).shape \
+            == (4, 224)
+        # env-supplied path wins
+        wpath = str(tmp_path / "w.npz")
+        self._tiny_weights(wpath)
+        monkeypatch.setenv("INCEPTION_WEIGHTS", wpath)
+        ext = inception_feature_fn(8, 8, 1, batch_size=8)
+        assert ext.source == f"inception:{wpath}"
