@@ -70,6 +70,21 @@ def fid_from_stats(real: FeatureStats, fake: FeatureStats, eps: float = 1e-6) ->
     return float(diff @ diff + np.trace(real.cov + fake.cov - 2.0 * covmean))
 
 
+def _batched(fwd, batch_size: int):
+    """Wrap a jitted (N,·)→(N,D) device forward into a chunked host-side
+    extractor: one device round trip per ``batch_size`` samples. Shared by
+    every feature extractor in this module so chunking fixes land once."""
+    import jax.numpy as jnp
+
+    def extract(samples: np.ndarray) -> np.ndarray:
+        chunks = []
+        for i in range(0, len(samples), batch_size):
+            chunks.append(np.asarray(fwd(jnp.asarray(samples[i : i + batch_size]))))
+        return np.concatenate(chunks, axis=0)
+
+    return extract
+
+
 # (out_channels, kernel, stride) per stage of the frozen extractor; the
 # feature vector concatenates each stage's spatial mean → 32+64+128 = 224 dims
 _FROZEN_STAGES = ((32, 5, 2), (64, 5, 2), (128, 3, 2))
@@ -125,14 +140,7 @@ def frozen_feature_fn(
             pooled.append(x.mean(axis=(1, 2)))
         return jnp.concatenate(pooled, axis=-1)
 
-    fwd = jax.jit(forward)
-
-    def extract(samples: np.ndarray) -> np.ndarray:
-        chunks = []
-        for i in range(0, len(samples), batch_size):
-            chunks.append(np.asarray(fwd(jnp.asarray(samples[i : i + batch_size]))))
-        return np.concatenate(chunks, axis=0)
-
+    extract = _batched(jax.jit(forward), batch_size)
     # the raw jittable (N,·)→(N,224) forward, for callers composing the
     # extractor with other device computations (e.g. generator→features in
     # one dispatch, scripts/quality_run.py's in-training tracker)
@@ -168,15 +176,22 @@ def inception_feature_fn(
          "nodes": [{"name": "c1", "op": "conv", "in": "input",
                     "stride": 2, "padding": "VALID", "activation": "relu",
                     "kernel": "c1/kernel", "bias": "c1/bias"},  # HWIO
+                   {"name": "b1", "op": "conv", "in": "c1", "stride": 1,
+                    "padding": "SAME", "kernel": "b1/kernel"},
                    {"name": "p1", "op": "maxpool", "in": "c1",
-                    "size": 3, "stride": 2, "padding": "VALID"},
-                   {"name": "b",  "op": "concat", "in": ["c1", "p1"]},
+                    "size": 3, "stride": 1, "padding": "SAME"},
+                   {"name": "b",  "op": "concat", "in": ["b1", "p1"]},
                    {"name": "f",  "op": "global_avgpool", "in": "b"}],
          "output": "f"}
 
-    Ops: ``conv`` (+optional bias/relu), ``maxpool``, ``avgpool``,
-    ``concat`` (channel axis), ``global_avgpool``. Inputs are resized to the
-    schema's spatial size (bilinear, matching the standard FID preprocessing
+    (``concat`` joins the channel axis, so its inputs must share spatial
+    dims — here both branches keep ``c1``'s via stride 1 + SAME.)
+
+    Ops: ``conv`` (+optional bias/relu), ``maxpool``, ``avgpool``
+    (zero-padding EXCLUDED from the divisor, matching the TF/pytorch-fid
+    ``count_include_pad=False`` semantics published FID numbers assume),
+    ``concat``, ``global_avgpool``. Inputs are resized to the schema's
+    spatial size (bilinear, matching the standard FID preprocessing
     pipeline) and grayscale is broadcast to the schema's channel count."""
     import json
     import os
@@ -240,11 +255,20 @@ def inception_feature_fn(
                         (-jnp.inf, jax.lax.max) if op == "maxpool"
                         else (0.0, jax.lax.add)
                     )
+                    pre_spatial = (1,) + y.shape[1:3] + (1,)
                     y = jax.lax.reduce_window(
                         y, init, fn, (1, k, k, 1), (1, s, s, 1), pad
                     )
                     if op == "avgpool":
-                        y = y / (k * k)
+                        # divide by the number of REAL elements per window
+                        # (padding excluded): TF / pytorch-fid use
+                        # count_include_pad=False, and published FID numbers
+                        # assume it — a plain /k² understates edge windows
+                        counts = jax.lax.reduce_window(
+                            jnp.ones(pre_spatial, y.dtype), 0.0, jax.lax.add,
+                            (1, k, k, 1), (1, s, s, 1), pad,
+                        )
+                        y = y / counts
                 elif op == "global_avgpool":
                     y = y.mean(axis=(1, 2))
                 else:
@@ -253,14 +277,7 @@ def inception_feature_fn(
         out = acts[out_name]
         return out.reshape(out.shape[0], -1)
 
-    fwd = jax.jit(forward)
-
-    def extract(samples: np.ndarray) -> np.ndarray:
-        chunks = []
-        for i in range(0, len(samples), batch_size):
-            chunks.append(np.asarray(fwd(jnp.asarray(samples[i : i + batch_size]))))
-        return np.concatenate(chunks, axis=0)
-
+    extract = _batched(jax.jit(forward), batch_size)
     extract.forward = forward
     extract.source = f"inception:{path}"
     return extract
@@ -273,17 +290,12 @@ def graph_feature_fn(graph, params, layer_name: str, batch_size: int = 500) -> C
     import jax.numpy as jnp
 
     tap = jax.jit(
-        lambda p, x: graph.feed_forward(p, x, train=False)[layer_name]
+        lambda x: graph.feed_forward(params, x, train=False)[layer_name]
     )
-
-    def extract(samples: np.ndarray) -> np.ndarray:
-        chunks = []
-        for i in range(0, len(samples), batch_size):
-            out = np.asarray(tap(params, jnp.asarray(samples[i : i + batch_size])))
-            chunks.append(out.reshape(out.shape[0], -1))
-        return np.concatenate(chunks, axis=0)
-
-    return extract
+    return _batched(
+        lambda x: (lambda out: out.reshape(out.shape[0], -1))(tap(x)),
+        batch_size,
+    )
 
 
 def fid_score(

@@ -265,16 +265,24 @@ def main() -> int:
     # Probe the env first — building the function without weights would
     # construct a frozen-extractor fallback only to throw it away.
     fid_inception = None
-    inc_fn = None
+    inc_source = None
     inc_path = os.environ.get("INCEPTION_WEIGHTS")
     if inc_path and os.path.exists(inc_path):
-        from gan_deeplearning4j_tpu.eval.fid import inception_feature_fn
+        # best-effort: a malformed weights file must not discard a completed
+        # multi-hour training run (the frozen/dis FIDs above already stand);
+        # record the failure in the report instead of crashing
+        try:
+            from gan_deeplearning4j_tpu.eval.fid import inception_feature_fn
 
-        inc_fn = inception_feature_fn(
-            cfg.height, cfg.width, cfg.channels, path=inc_path, batch_size=2500
-        )
-        fid_inception = fid_score(xtr, fakes, inc_fn)
-        print(f"inception FID ({inc_fn.source}): {fid_inception:.2f}", flush=True)
+            inc_fn = inception_feature_fn(
+                cfg.height, cfg.width, cfg.channels, path=inc_path, batch_size=2500
+            )
+            fid_inception = fid_score(xtr, fakes, inc_fn)
+            inc_source = inc_fn.source
+            print(f"inception FID ({inc_source}): {fid_inception:.2f}", flush=True)
+        except Exception as exc:
+            inc_source = f"error: {type(exc).__name__}: {exc}"
+            print(f"inception FID skipped — {inc_source}", flush=True)
     fid_best = None
     if not best_is_final:
         fid_best = frozen_fid(sample_fakes(best["gen_params"]))
@@ -308,7 +316,7 @@ def main() -> int:
         "fid_inception": (
             None if fid_inception is None else round(float(fid_inception), 3)
         ),
-        "fid_inception_source": None if fid_inception is None else inc_fn.source,
+        "fid_inception_source": inc_source,
         "best_checkpoint": None if not selection_ran else {
             "iteration": best["iteration"],
             "is_final": best_is_final,

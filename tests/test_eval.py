@@ -257,6 +257,31 @@ class TestInceptionHook:
         )
         assert np.isfinite(fid) and fid >= 0.0
 
+    def test_avgpool_excludes_padding_from_divisor(self, tmp_path):
+        """SAME-padded avgpool must divide by the REAL window element count
+        (TF / pytorch-fid count_include_pad=False): a constant input then
+        pools to exactly that constant everywhere — a /k² divisor would
+        understate the edges and break literature comparability."""
+        import json
+
+        from gan_deeplearning4j_tpu.eval import inception_feature_fn
+
+        schema = {
+            "input": {"height": 6, "width": 6, "channels": 1},
+            "nodes": [
+                {"name": "p", "op": "avgpool", "in": "input", "size": 3,
+                 "stride": 1, "padding": "SAME"},
+                {"name": "f", "op": "global_avgpool", "in": "p"},
+            ],
+            "output": "f",
+        }
+        wpath = str(tmp_path / "avg.npz")
+        np.savez(wpath, __schema__=json.dumps(schema))
+        extract = inception_feature_fn(6, 6, 1, path=wpath, batch_size=4)
+        x = np.full((3, 36), 0.625, dtype=np.float32)
+        feats = extract(x)
+        np.testing.assert_allclose(feats, 0.625, rtol=1e-6)
+
     def test_env_var_and_fallback(self, tmp_path, monkeypatch):
         from gan_deeplearning4j_tpu.eval import inception_feature_fn
 
