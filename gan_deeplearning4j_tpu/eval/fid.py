@@ -289,13 +289,13 @@ def graph_feature_fn(graph, params, layer_name: str, batch_size: int = 500) -> C
     import jax
     import jax.numpy as jnp
 
+    # params stay a traced ARGUMENT: closing them into the jit would bake
+    # the whole parameter pytree into the executable as constants
     tap = jax.jit(
-        lambda x: graph.feed_forward(params, x, train=False)[layer_name]
+        lambda p, x: graph.feed_forward(p, x, train=False)[layer_name]
+        .reshape(x.shape[0], -1)
     )
-    return _batched(
-        lambda x: (lambda out: out.reshape(out.shape[0], -1))(tap(x)),
-        batch_size,
-    )
+    return _batched(lambda x: tap(params, x), batch_size)
 
 
 def fid_score(

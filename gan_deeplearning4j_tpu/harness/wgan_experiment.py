@@ -173,7 +173,14 @@ class WganGpExperiment(GanExperiment):
     def flops_per_iteration(self, batch_size=None) -> float:
         """FLOPs of one WGAN-GP round (critic scan + generator step) from
         XLA's post-optimization cost analysis — includes the grad-of-grad
-        penalty as compiled. None if the backend has no cost model."""
+        penalty as compiled. None if the backend has no cost model.
+
+        Scan caveat (round-4 finding, scripts/profile_wgan.py): XLA's
+        cost_analysis counts a ``lax.scan`` body ONCE, independent of trip
+        count — verified by lowering the round at n_critic 2 vs 4 (identical
+        "flops"). The critic round therefore multiplies by ``n_critic``;
+        without it every WGAN MFU reads ~n_critic× too low (round 3's 3.2%
+        was really ~16%)."""
         mcfg = self.model_cfg
         b = batch_size or self.config.batch_size_train
         n = mcfg.n_critic
@@ -191,7 +198,7 @@ class WganGpExperiment(GanExperiment):
             ).compile().cost_analysis()
         if not critic or "flops" not in critic or not gen or "flops" not in gen:
             return None
-        return float(critic["flops"]) + float(gen["flops"])
+        return float(critic["flops"]) * n + float(gen["flops"])
 
     # -- exports --------------------------------------------------------
     # export_manifold is inherited from GanExperiment: it reads

@@ -1,0 +1,193 @@
+"""Decompose the WGAN-GP round on-chip (round-4 VERDICT item 4).
+
+Round 3 measured config 5 (WGAN-GP CIFAR-10) at 3.2% MFU with 25.6%
+cross-chunk jitter and left the round unexamined. This script answers the
+open question — does the gradient-penalty double-grad recompute the critic
+forward? — with XLA's own numbers, and captures the evidence the PROFILE.md
+analysis needs:
+
+1. cost analysis (FLOPs / bytes) of separately compiled subprograms at the
+   bench shapes: critic forward, Wasserstein-term grad (no GP), GP-term
+   grad, the full critic-loss grad, one fused critic round (n_critic scanned
+   steps), and the generator step. The ratio
+   ``full_grad / (w_grad + gp_grad)`` exposes cross-term sharing;
+   ``gp_grad / forward`` against the analytic ~5x (fwd + bwd for the inner
+   gradient, then a second backward through it) exposes rematerialization.
+2. wall-clock of the scanned round window (the bench's scan-32 shape) with
+   cross-chunk jitter, traced to ``--trace-dir`` for Perfetto.
+
+Writes ``--out`` JSON. ``--cpu`` runs the plumbing on tiny shapes for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--height", type=int, default=32)
+    ap.add_argument("--width", type=int, default=32)
+    ap.add_argument("--channels", type=int, default=3)
+    ap.add_argument("--z-size", type=int, default=128)
+    ap.add_argument("--n-critic", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=64, help="per-critic-step batch")
+    ap.add_argument("--scan-window", type=int, default=32)
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--trace-dir", default="artifacts/trace_wgan")
+    ap.add_argument("--out", default="artifacts/profile_wgan.json")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from gan_deeplearning4j_tpu.models.wgan_gp import WganGpConfig, WganGpTrainer
+    from gan_deeplearning4j_tpu.ops import losses as loss_ops
+    from gan_deeplearning4j_tpu.utils.profiling import device_trace
+
+    cfg = WganGpConfig(
+        height=args.height, width=args.width, channels=args.channels,
+        z_size=args.z_size, n_critic=args.n_critic,
+        **({"base_filters": 8, "dense_width": 32} if args.cpu else {}),
+    )
+    tr = WganGpTrainer(cfg)
+    critic_state, gen_state = tr.init_states(seed=0)
+    b = args.batch
+    f = cfg.num_features
+    rng = np.random.default_rng(0)
+    real = jnp.asarray(rng.random((b, f), dtype=np.float32))
+    key = jax.random.PRNGKey(1)
+    k_z, k_gp = jax.random.split(key)
+
+    def cost_of(fn, *fn_args):
+        """(flops, bytes) of the compiled program for fn at these args."""
+        c = jax.jit(fn).lower(*fn_args).compile().cost_analysis() or {}
+        return float(c.get("flops", 0.0)), float(c.get("bytes accessed", 0.0))
+
+    def score(params, x):
+        return tr.critic.output(params, x, train=False)[:, 0]
+
+    def gen_fake():
+        # every loss term below derives fakes the same way _critic_loss
+        # does (generator forward in-graph, same key), so the term programs
+        # are structurally comparable — using a precomputed host array for
+        # some terms would bias full/sum upward by one generator forward
+        z = jax.random.normal(k_z, (b, cfg.z_size), jnp.float32)
+        return tr.generator.output(gen_state.params, z, train=False).reshape(b, -1)
+
+    def w_loss(params):
+        fk = gen_fake()
+        return jnp.mean(score(params, fk)) - jnp.mean(score(params, real))
+
+    def gp_loss(params):
+        return loss_ops.gradient_penalty(
+            lambda x: score(params, x), real, gen_fake(), k_gp
+        )
+
+    def full_loss(params):
+        return tr._critic_loss(params, gen_state.params, real, key)
+
+    costs = {}
+    costs["critic_forward"] = cost_of(score, critic_state.params, real)
+    costs["generator_forward"] = cost_of(gen_fake)
+    costs["w_term_grad"] = cost_of(jax.grad(w_loss), critic_state.params)
+    costs["gp_term_grad"] = cost_of(jax.grad(gp_loss), critic_state.params)
+    costs["full_loss_grad"] = cost_of(jax.grad(full_loss), critic_state.params)
+    from gan_deeplearning4j_tpu.harness.experiment import shape_struct
+
+    costs["critic_round"] = tuple(
+        float((tr._critic_round.lower(
+            shape_struct(critic_state), shape_struct(gen_state.params),
+            jax.ShapeDtypeStruct((cfg.n_critic, b, f), jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        ).compile().cost_analysis() or {}).get(k, 0.0))
+        for k in ("flops", "bytes accessed")
+    )
+    costs["gen_step"] = tuple(
+        float((tr._gen_step.lower(
+            shape_struct(gen_state), shape_struct(critic_state.params),
+            jax.ShapeDtypeStruct((b, cfg.z_size), jnp.float32),
+        ).compile().cost_analysis() or {}).get(k, 0.0))
+        for k in ("flops", "bytes accessed")
+    )
+
+    fwd_f = costs["critic_forward"][0]
+    gen_f = costs["generator_forward"][0]
+    w_f, gp_f, full_f = (costs[k][0] for k in
+                         ("w_term_grad", "gp_term_grad", "full_loss_grad"))
+    # each standalone term program embeds one generator forward; subtract it
+    # so the sharing ratio compares CRITIC work only (the denominator would
+    # otherwise double-count fake generation)
+    w_c, gp_c, full_c = w_f - gen_f, gp_f - gen_f, full_f - gen_f
+    analysis = {
+        # ~5x fwd analytic floor for the GP term (inner fwd+bwd, then a
+        # second backward through the inner gradient); materially above
+        # that = XLA rematerializes the critic forward inside the double-grad
+        "gp_grad_over_forward": round(gp_c / fwd_f, 2) if fwd_f else None,
+        # ≈1.0 = no sharing between the Wasserstein and GP terms (each
+        # compiled standalone); <1.0 = the fused program shares work
+        "full_over_sum_of_terms": round(full_c / (w_c + gp_c), 3)
+        if (w_c + gp_c) else None,
+        # the scanned round vs n_critic standalone steps — scan overhead
+        "round_over_ncritic_fullgrad": round(
+            costs["critic_round"][0] / (cfg.n_critic * full_f), 3
+        ) if full_f else None,
+    }
+
+    # -- wall clock: the bench's scan-window shape, traced ------------------
+    k_iters = args.scan_window
+    rounds = jnp.asarray(
+        rng.random((k_iters, cfg.n_critic, b, f), dtype=np.float32)
+    )
+    cs, gs = critic_state, gen_state
+    cs, gs, c_l, g_l = tr.train_rounds(cs, gs, rounds, jax.random.PRNGKey(2))
+    np.asarray(c_l)  # compile + settle
+    chunk_secs = []
+    with device_trace(args.trace_dir):
+        for _ in range(args.chunks):
+            t0 = time.perf_counter()
+            cs, gs, c_l, g_l = tr.train_rounds(cs, gs, rounds, jax.random.PRNGKey(3))
+            np.asarray(c_l)  # value fetch = the only true fence on axon
+            chunk_secs.append(time.perf_counter() - t0)
+    per_round = np.asarray(chunk_secs) / k_iters
+    wall = {
+        "scan_window": k_iters,
+        "sec_per_round": round(float(per_round.mean()), 6),
+        "images_per_sec": round(cfg.n_critic * b / float(per_round.mean()), 2),
+        "cross_chunk_jitter": round(
+            float(per_round.std(ddof=1) / per_round.mean()), 4
+        ),
+        "chunk_seconds": [round(s, 4) for s in chunk_secs],
+    }
+
+    out = {
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "shapes": {"batch": b, "n_critic": cfg.n_critic, "features": f,
+                   "z_size": cfg.z_size},
+        "costs_flops_bytes": {k: list(v) for k, v in costs.items()},
+        "analysis": analysis,
+        "wall": wall,
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(out, fh, indent=2)
+    print(json.dumps({"analysis": analysis, "wall": wall}), flush=True)
+    print(f"wrote {args.out}; trace under {args.trace_dir}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

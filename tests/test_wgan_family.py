@@ -125,7 +125,25 @@ class TestWganExperimentLoop:
         with pytest.raises(ValueError):
             exp.export_predictions(None, 1)
 
-    def test_sample_shape(self, tmp_path):
+    def test_flops_cost_counts_all_critic_steps(self, tmp_path):
+        """XLA's cost_analysis counts a lax.scan body ONCE regardless of trip
+        count (round-4 finding), so flops_per_iteration must multiply the
+        critic-round cost by n_critic — doubling n_critic (at the same
+        per-step batch) must roughly add the critic cost again, not leave
+        the total flat. Without the fix, every WGAN MFU reads ~n_critic×
+        too low."""
+        from gan_deeplearning4j_tpu.harness import make_experiment
+
+        flops = {}
+        for n in (2, 4):
+            exp = make_experiment(tiny_config(
+                tmp_path, n_critic=n, batch_size_train=4 * n,
+                batch_size_pred=4 * n,
+            ))
+            flops[n] = exp.flops_per_iteration()
+        assert flops[2] and flops[4]
+        ratio = flops[4] / flops[2]  # (4c+g)/(2c+g) ∈ (1, 2)
+        assert 1.3 < ratio < 2.05, ratio
         exp = make_experiment(tiny_config(tmp_path))
         imgs = exp.sample(4)
         assert imgs.shape == (4, 8, 8, 1)
