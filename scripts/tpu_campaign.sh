@@ -9,6 +9,7 @@
 # restore must cover the FULL output set, not just two files).
 cd /root/repo || exit 1
 bench_done=0
+profile_done=0
 quality_done=0
 for i in $(seq 1 300); do
   echo "$(date +%H:%M:%S) probe $i" >> tpu_poller.log
@@ -61,6 +62,18 @@ EOF
       fi
       echo "$(date +%H:%M:%S) bench rc=$rc/$rc2 done=$bench_done" >> tpu_poller.log
     fi
+    if [ "$profile_done" -eq 0 ]; then
+      echo "$(date +%H:%M:%S) wgan profile" >> tpu_poller.log
+      rm -f artifacts/profile_wgan.json
+      timeout 900 python scripts/profile_wgan.py > profile_wgan.log 2>&1
+      rc=$?
+      if [ "$rc" -eq 0 ] && python -c "import json,sys; sys.exit(0 if json.load(open('artifacts/profile_wgan.json'))['platform']!='cpu' else 1)" 2>/dev/null; then
+        profile_done=1
+      else
+        git checkout -- artifacts/profile_wgan.json 2>/dev/null
+      fi
+      echo "$(date +%H:%M:%S) wgan profile rc=$rc done=$profile_done" >> tpu_poller.log
+    fi
     if [ "$quality_done" -eq 0 ]; then
       echo "$(date +%H:%M:%S) quality run" >> tpu_poller.log
       # quality_run.json is written LAST by the script, so its presence with
@@ -81,7 +94,7 @@ EOF
       fi
       echo "$(date +%H:%M:%S) quality rc=$rc done=$quality_done" >> tpu_poller.log
     fi
-    if [ "$bench_done" -eq 1 ] && [ "$quality_done" -eq 1 ]; then exit 0; fi
+    if [ "$bench_done" -eq 1 ] && [ "$profile_done" -eq 1 ] && [ "$quality_done" -eq 1 ]; then exit 0; fi
   fi
   sleep 60
 done
