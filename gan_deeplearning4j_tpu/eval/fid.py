@@ -283,6 +283,50 @@ def inception_feature_fn(
     return extract
 
 
+def quick_fid_scorer(
+    exp,
+    frozen_fn,
+    real_stats: FeatureStats,
+    num_samples: int = 2048,
+    seed: int = 679,
+) -> Callable:
+    """In-training quick-FID tracker shared by ``scripts/quality_run.py``
+    and ``scripts/tune_sweep.py`` (previously two hand-synced copies).
+
+    Returns ``score(experiment, index) -> fid``: generator→frozen-features
+    composed as ONE jitted device program over a FIXED z set (paired across
+    every eval, so successive scores differ by model state, not sampling
+    noise), scored against precomputed ``real_stats``. Appends
+    ``[index, fid]`` to ``score.curve``; a repeated call for the SAME index
+    returns the cached value instead of re-evaluating — callers can
+    unconditionally score the final iteration without duplicating the entry
+    when the callback cadence already landed on it."""
+    import jax
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.runtime.dtype import compute_dtype_scope
+
+    z_size = exp.model_cfg.z_size
+    z = np.random.default_rng(seed).random(
+        (num_samples, z_size), dtype=np.float32
+    ) * 2.0 - 1.0
+    z_dev = jnp.asarray(z)
+    gen_features = jax.jit(lambda p, zz: frozen_fn.forward(exp._gen_fwd(p, zz)))
+    curve: list = []
+
+    def score(e, index) -> float:
+        if curve and curve[-1][0] == index:
+            return curve[-1][1]
+        with compute_dtype_scope(e._compute_dtype):
+            feats = np.asarray(gen_features(e.gen_params, z_dev))
+        fid = float(fid_from_stats(real_stats, FeatureStats.from_features(feats)))
+        curve.append([index, round(fid, 3)])
+        return fid
+
+    score.curve = curve
+    return score
+
+
 def graph_feature_fn(graph, params, layer_name: str, batch_size: int = 500) -> Callable:
     """Feature extractor tapping ``layer_name``'s activation of a framework
     graph (ComputationGraph.feed_forward), batched on device."""

@@ -137,23 +137,16 @@ def main() -> int:
     best = {"iteration": None, "fid": None, "gen_params": None, "curve": []}
     eval_callback = None
     if not args.no_select_best:
-        z_eval = np.random.default_rng(args.seed + 13).random(
-            (args.select_samples, cfg.z_size), dtype=np.float32
-        ) * 2.0 - 1.0
-        z_eval_dev = jnp.asarray(z_eval)
-        gen_features = jax.jit(
-            lambda p, z: frozen_fn.forward(exp._gen_fwd(p, z))
+        from gan_deeplearning4j_tpu.eval.fid import quick_fid_scorer
+
+        score = quick_fid_scorer(
+            exp, frozen_fn, real_stats,
+            num_samples=args.select_samples, seed=args.seed + 13,
         )
+        best["curve"] = score.curve
 
         def score_and_track(e, index):
-            from gan_deeplearning4j_tpu.runtime.dtype import compute_dtype_scope
-
-            with compute_dtype_scope(e._compute_dtype):
-                feats = np.asarray(gen_features(e.gen_params, z_eval_dev))
-            fid_q = fid_from_stats(
-                real_stats, FeatureStats.from_features(feats)
-            )
-            best["curve"].append([index, round(fid_q, 3)])
+            fid_q = score(e, index)
             if best["fid"] is None or fid_q < best["fid"]:
                 best.update(
                     iteration=index, fid=fid_q,
@@ -163,13 +156,11 @@ def main() -> int:
         eval_callback = score_and_track
 
     result = exp.run(train_it, test_it, eval_callback=eval_callback)
-    if eval_callback is not None and not (
-        best["curve"] and best["curve"][-1][0] == result["iterations"]
-    ):
-        # the callback cadence usually misses the last iteration (it fires at
-        # batch_counter % export_every == 0) — score the final generator too,
-        # unless the cadence did land on it, so a monotone-improving run
-        # selects the final state exactly once
+    if eval_callback is not None:
+        # the callback cadence usually misses the last iteration (it fires
+        # at batch_counter % export_every == 0) — score the final generator
+        # too; the scorer dedups when the cadence did land on it, so a
+        # monotone-improving run selects the final state exactly once
         score_and_track(exp, result["iterations"])
     ips = [h["images_per_sec"] for h in result["history"]]
     print(

@@ -11,6 +11,7 @@ cd /root/repo || exit 1
 bench_done=0
 profile_done=0
 quality_done=0
+tune_done=0
 for i in $(seq 1 300); do
   echo "$(date +%H:%M:%S) probe $i" >> tpu_poller.log
   if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
@@ -94,7 +95,21 @@ EOF
       fi
       echo "$(date +%H:%M:%S) quality rc=$rc done=$quality_done" >> tpu_poller.log
     fi
-    if [ "$bench_done" -eq 1 ] && [ "$profile_done" -eq 1 ] && [ "$quality_done" -eq 1 ]; then exit 0; fi
+    if [ "$quality_done" -eq 1 ] && [ "$tune_done" -eq 0 ]; then
+      # LAST priority: the LR sweep (round-3 weak #7) only runs once the
+      # round's primary artifacts are secured
+      echo "$(date +%H:%M:%S) tuning sweep" >> tpu_poller.log
+      rm -f artifacts/tuning_sweep.json
+      timeout 3000 python scripts/tune_sweep.py > tune_sweep.log 2>&1
+      rc=$?
+      if [ "$rc" -eq 0 ] && python -c "import json,sys; sys.exit(0 if json.load(open('artifacts/tuning_sweep.json'))['platform']!='cpu' else 1)" 2>/dev/null; then
+        tune_done=1
+      else
+        rm -f artifacts/tuning_sweep.json
+      fi
+      echo "$(date +%H:%M:%S) tune rc=$rc done=$tune_done" >> tpu_poller.log
+    fi
+    if [ "$bench_done" -eq 1 ] && [ "$profile_done" -eq 1 ] && [ "$quality_done" -eq 1 ] && [ "$tune_done" -eq 1 ]; then exit 0; fi
   fi
   sleep 60
 done
