@@ -12,7 +12,16 @@ bench_done=0
 profile_done=0
 quality_done=0
 tune_done=0
+# Hard stop: the TPU is exclusive per process, so this campaign must be GONE
+# well before the round-end driver bench needs the chip. Default 8.5 h from
+# launch; override with CAMPAIGN_BUDGET_S. A started step may run past the
+# deadline by its own timeout at worst — the margin accounts for that.
+deadline=$(( $(date +%s) + ${CAMPAIGN_BUDGET_S:-30600} ))
 for i in $(seq 1 300); do
+  if [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "$(date +%H:%M:%S) campaign deadline — exiting (bench=$bench_done profile=$profile_done quality=$quality_done tune=$tune_done)" >> tpu_poller.log
+    exit 0
+  fi
   echo "$(date +%H:%M:%S) probe $i" >> tpu_poller.log
   if timeout 150 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; then
     if [ "$bench_done" -eq 0 ]; then
