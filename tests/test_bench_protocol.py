@@ -1,0 +1,104 @@
+"""The bench's driver-facing output protocol (round-4 VERDICT item 1).
+
+The contract that lost round 3 when unmet: at ANY instant the bench process
+might be killed, its last stdout line must be a complete, parseable summary
+JSON carrying the headline metric and one entry per requested config. These
+tests pin the Reporter half of that contract (the measurement half is
+exercised end-to-end by running ``bench.py`` itself — see BASELINE.md).
+"""
+
+import io
+import json
+import sys
+
+import bench
+
+
+def _lines(capsys_text):
+    return [json.loads(l) for l in capsys_text.strip().splitlines()]
+
+
+class TestReporter:
+    def _reporter(self, keys=("1", "5"), baselines=None, t0=0.0):
+        return bench.Reporter(
+            list(keys),
+            baselines if baselines is not None
+            else {bench.CONFIG_META["1"][0]: 1000.0},
+            None,
+            t0,
+        )
+
+    def test_preliminary_line_is_complete_summary(self, capsys):
+        r = self._reporter()
+        r.emit()
+        (line,) = _lines(capsys.readouterr().out)
+        assert line["metric"] == bench.CONFIG_META["1"][0]
+        assert line["value"] == 1000.0  # stale baseline stands in
+        assert line["vs_baseline"] is None
+        assert line["stale"] and line["preliminary"] and line["degraded"]
+        assert len(line["results"]) == 2
+        for res in line["results"]:
+            assert res["stale"] and res["skipped"] == "not reached"
+
+    def test_measured_result_takes_headline(self, capsys):
+        r = self._reporter()
+        r.diag.update(platform="tpu", device_kind="x", degraded=False)
+        r.set_result("1", {"config": "1", "metric": bench.CONFIG_META["1"][0],
+                           "value": 2000.0, "vs_baseline": 2.0, "mfu": 0.1})
+        line = _lines(capsys.readouterr().out)[-1]
+        assert line["value"] == 2000.0
+        assert line["vs_baseline"] == 2.0
+        assert "stale" not in line
+        # the OTHER config still appears as a labeled placeholder
+        by_cfg = {res["config"]: res for res in line["results"]}
+        assert by_cfg["5"]["skipped"] == "not reached"
+        assert by_cfg["1"]["value"] == 2000.0
+
+    def test_every_emit_is_parseable_and_reemits_everything(self, capsys):
+        r = self._reporter(keys=("1", "5", "2"))
+        r.emit()
+        r.set_result("5", {"config": "5", "metric": bench.CONFIG_META["5"][0],
+                           "value": 7.0})
+        r.set_result("2", r.stale_entry("2", "budget: 3s left"))
+        lines = _lines(capsys.readouterr().out)
+        assert len(lines) == 3  # one full summary per state change
+        assert all(len(l["results"]) == 3 for l in lines)
+        last = {res["config"]: res for res in lines[-1]["results"]}
+        assert last["5"]["value"] == 7.0
+        assert last["2"]["skipped"] == "budget: 3s left"
+
+    def test_headline_falls_back_to_first_requested_config(self, capsys):
+        r = self._reporter(keys=("5", "2"), baselines={})
+        r.emit()
+        (line,) = _lines(capsys.readouterr().out)
+        assert line["metric"] == bench.CONFIG_META["5"][0]
+        assert line["value"] is None  # no baseline for it either
+
+    def test_json_file_mirrors_stdout(self, tmp_path, capsys):
+        path = str(tmp_path / "bench.json")
+        r = bench.Reporter(["1"], {}, path, 0.0)
+        r.set_result("1", {"config": "1", "metric": bench.CONFIG_META["1"][0],
+                           "value": 5.0})
+        capsys.readouterr()
+        with open(path) as fh:
+            d = json.load(fh)
+        assert d["results"][0]["value"] == 5.0
+        assert "diagnostics" in d
+
+
+class TestConfigTables:
+    def test_config_tables_consistent(self):
+        assert set(bench.CONFIG_ORDER) == set(bench.CONFIGS) == set(bench.CONFIG_META)
+        assert bench.CONFIG_ORDER[0] == bench.HEADLINE == "1"
+
+    def test_cheap_opts_stay_cheap(self):
+        # the degraded path must never pick up expensive settings by accident:
+        # XLA:CPU needs 70-140 s to COMPILE a scan program and tens of
+        # seconds per call (measured round 4)
+        assert bench.CHEAP_OPTS["scan_cap"] <= 1
+        assert bench.CHEAP_OPTS["min_measured_s"] <= 1.0
+        assert bench.CHEAP_OPTS["cheap"] is True
+        assert bench.FULL_OPTS["cheap"] is False
+
+    def test_axon_boot_vars_cover_the_relay_dial(self):
+        assert "PALLAS_AXON_POOL_IPS" in bench.AXON_BOOT_VARS
