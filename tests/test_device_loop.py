@@ -288,6 +288,27 @@ class TestParamAveragingDeviceLoop:
             )
 
     @pytest.mark.slow
+    def test_run_windows_engage_in_averaging_mode(self, tmp_path):
+        """The full run() loop under distributed="param_averaging" takes scan
+        windows (train_rounds timing phase present), produces finite history
+        for every iteration, and exports on cadence — the loop-level
+        integration the direct train_iterations tests don't cover."""
+        exp, _ = self._exp(
+            num_iterations=6, print_every=1000, loss_fetch_every=4,
+            output_dir=str(tmp_path),
+        )
+        rng = np.random.default_rng(5)
+        flat_f = rng.random((16 * 6, 784), dtype=np.float32)
+        flat_l = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16 * 6)]
+        it = DeviceResidentIterator(flat_f, flat_l, batch_size=16)
+        out = exp.run(it)
+        assert out["iterations"] == 6
+        assert len(out["history"]) == 6
+        assert all(np.isfinite(h["d_loss"]) for h in out["history"])
+        # the scan window actually engaged (vs 6 per-dispatch iterations)
+        assert "train_window" in out["timings"]
+
+    @pytest.mark.slow
     def test_averaging_loop_differs_from_pmean_loop(self):
         """The faithful mode is a different algorithm from per-step gradient
         sync (SURVEY §7): local steps diverge before the average."""
