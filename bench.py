@@ -671,9 +671,8 @@ def run_child(keys, mode, cpu, ready_timeout, per_config_timeout, reporter,
             child.kill()
             return "stalled", pending
         if ev.get("event") == "result":
-            k = ev.pop("config", pending[0])
-            ev.pop("event", None)
-            ev["config"] = k
+            ev.pop("event")
+            k = ev.get("config", pending[0])
             reporter.set_result(k, ev)
             if k in pending:
                 pending.remove(k)

@@ -63,6 +63,31 @@ def shape_struct(tree):
     )
 
 
+def _one_opt_step(graph, opt, state: TrainState, feats, labels, key):
+    """One optimizer step on one minibatch — the traced core both fused-body
+    builders (GraphTrainer mode and shard_map averaging mode) scan over."""
+
+    def loss_fn(p):
+        loss, (_, new_p) = graph.loss(p, feats, labels, train=True, rng=key)
+        return loss, new_p
+
+    (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params
+    )
+    params, opt_state = opt.step(new_params, grads, state.opt_state)
+    return TrainState(params, opt_state, state.step + 1), loss
+
+
+def _rebind(src: TrainState, dst: TrainState, mapping) -> TrainState:
+    """Weight sync as pure pytree rewiring (the reference's setParam blocks,
+    :429-542) — zero copies inside a jitted program."""
+    return TrainState(
+        ComputationGraph.copy_params(src.params, dst.params, mapping),
+        dst.opt_state,
+        dst.step,
+    )
+
+
 def latent_grid(n: int, z_size: int = 2) -> np.ndarray:
     """The n×n manifold grid over linspace(−1,1,n)² (reference :382-389).
     For z_size > 2 the remaining dims are zero (grid spans the first two)."""
@@ -239,25 +264,7 @@ class GanExperiment:
     def _build_fused_iteration(self):
         """Jit the full alternating iteration (§3.2 steps a–f) as one program."""
         gen_graph = self.gen
-
-        def one_step(graph, opt, state: TrainState, feats, labels, key):
-            def loss_fn(p):
-                loss, (_, new_p) = graph.loss(p, feats, labels, train=True, rng=key)
-                return loss, new_p
-
-            (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
-            )
-            params, opt_state = opt.step(new_params, grads, state.opt_state)
-            return TrainState(params, opt_state, state.step + 1), loss
-
-        def rebind(src: TrainState, dst: TrainState, mapping) -> TrainState:
-            return TrainState(
-                ComputationGraph.copy_params(src.params, dst.params, mapping),
-                dst.opt_state,
-                dst.step,
-            )
-
+        one_step, rebind = _one_opt_step, _rebind
         z_size = self.model_cfg.z_size
         base_key = jax.random.PRNGKey(self.config.seed + 2)
 
@@ -351,32 +358,15 @@ class GanExperiment:
 
         axis = "data"
         gen_graph = self.gen
+        one_step, rebind = _one_opt_step, _rebind
         z_size = self.model_cfg.z_size
         base_key = jax.random.PRNGKey(self.config.seed + 2)
-
-        def one_step(graph, opt, state: TrainState, feats, labels, key):
-            def loss_fn(p):
-                loss, (_, new_p) = graph.loss(p, feats, labels, train=True, rng=key)
-                return loss, new_p
-
-            (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
-            )
-            params, opt_state = opt.step(new_params, grads, state.opt_state)
-            return TrainState(params, opt_state, state.step + 1), loss
 
         def avg(state: TrainState) -> TrainState:
             return TrainState(
                 _average_tree(state.params, axis),
                 _average_tree(state.opt_state, axis),
                 state.step,
-            )
-
-        def rebind(src: TrainState, dst: TrainState, mapping) -> TrainState:
-            return TrainState(
-                ComputationGraph.copy_params(src.params, dst.params, mapping),
-                dst.opt_state,
-                dst.step,
             )
 
         def body(dis_state, gan_state, cv_state, gen_params,

@@ -249,15 +249,16 @@ class TestParamAveragingDeviceLoop:
         for k in ("d_loss", "g_loss", "cv_loss"):
             assert np.isfinite(np.asarray(out[k])).all()
         # post-averaging invariant: every device's replica is bit-identical
-        # for params AND updater state (the reference averages both, D16)
-        for state in (exp.dis_state, exp.gan_state, exp.cv_state):
-            for leaf in jax.tree_util.tree_leaves((state.params, state.opt_state)):
-                shards = getattr(leaf, "addressable_shards", None)
-                if not shards or len(shards) < 2:
-                    continue
-                first = np.asarray(shards[0].data)
-                for s in shards[1:]:
-                    np.testing.assert_array_equal(first, np.asarray(s.data))
+        # for params AND updater state (the reference averages both, D16) —
+        # same checker the driver dryrun uses
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from __graft_entry__ import _assert_replicated
+
+        for name, state in (("dis", exp.dis_state), ("gan", exp.gan_state),
+                            ("cv", exp.cv_state)):
+            _assert_replicated((state.params, state.opt_state), f"{name} state")
         assert int(exp.dis_state.step) == 4  # 2 iterations x 2 dis steps
 
     @pytest.mark.slow
