@@ -672,7 +672,7 @@ def run_child(keys, mode, cpu, ready_timeout, per_config_timeout, reporter,
             return "stalled", pending
         if ev.get("event") == "result":
             ev.pop("event")
-            k = ev.get("config", pending[0])
+            k = ev.setdefault("config", pending[0])
             reporter.set_result(k, ev)
             if k in pending:
                 pending.remove(k)

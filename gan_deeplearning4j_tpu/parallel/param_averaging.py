@@ -313,16 +313,45 @@ class ParameterAveragingTrainer:
             return state, rng
 
         def drain_full(state, rng):
+            """Run every buffered FULL round. Multiple rounds go through
+            fit_rounds as ONE scanned dispatch (round-4 device loop); its
+            internal key chain is exactly the split(rng)-per-round sequence
+            used here, so the caller advances rng by k splits to stay
+            aligned with the sequential path (bit-compatible either way)."""
             nonlocal buf_f, buf_l, buffered
             feats = np.concatenate(buf_f, axis=0) if len(buf_f) > 1 else buf_f[0]
             labs = np.concatenate(buf_l, axis=0) if len(buf_l) > 1 else buf_l[0]
-            while feats.shape[0] >= self.round_examples:
-                used = self.round_examples
+            k = feats.shape[0] // self.round_examples
+            if k > 1:
+                used = k * self.round_examples
+                freq, b = self.averaging_frequency, self.batch_size_per_worker
+
+                def regroup(arr: np.ndarray) -> np.ndarray:
+                    # vectorized per-round worker-major regroup — one pass,
+                    # no k temporaries (same layout as _worker_major applied
+                    # to each round slice then stacked)
+                    return (
+                        arr[:used]
+                        .reshape((k, freq, self.num_workers, b) + arr.shape[1:])
+                        .swapaxes(1, 2)
+                        .reshape((k, self.round_examples) + arr.shape[1:])
+                    )
+
+                state, round_losses = self.fit_rounds(
+                    state, jnp.asarray(regroup(feats)), jnp.asarray(regroup(labs)), rng
+                )
+                losses.extend(float(x) for x in np.asarray(round_losses).ravel())
+                for _ in range(k):  # keep the caller's chain aligned
+                    rng, _ = jax.random.split(rng)
+                feats, labs = feats[used:], labs[used:]
+            elif k == 1:
                 state, rng = run_round(
                     state, rng, feats, labs,
                     self.averaging_frequency, self.batch_size_per_worker,
                 )
-                feats, labs = feats[used:], labs[used:]
+                feats, labs = (
+                    feats[self.round_examples:], labs[self.round_examples:]
+                )
             buf_f = [feats] if feats.shape[0] else []
             buf_l = [labs] if labs.shape[0] else []
             buffered = feats.shape[0]
