@@ -86,6 +86,82 @@ class TestReporter:
         assert "diagnostics" in d
 
 
+class FakeChild:
+    """Scripted stand-in for bench.Child: serves a fixed event sequence,
+    then times out forever."""
+
+    def __init__(self, events):
+        self.events = list(events)
+        self.killed = False
+
+    def next_event(self, timeout):
+        return self.events.pop(0) if self.events else None
+
+    def kill(self):
+        self.killed = True
+
+
+class TestRunChildStateMachine:
+    def _run(self, monkeypatch, events, keys=("1", "5"), cpu=False):
+        children = []
+
+        def fake_child(k, mode, c, deadline):
+            child = FakeChild(events)
+            children.append(child)
+            return child
+
+        monkeypatch.setattr(bench, "Child", fake_child)
+        r = bench.Reporter(list(keys), {}, None, 0.0)
+        status, remaining = bench.run_child(
+            list(keys), "full", cpu, ready_timeout=1.0, per_config_timeout=1.0,
+            reporter=r, measure_deadline=bench.time.time() + 60,
+        )
+        return status, remaining, r, children
+
+    def test_no_ready_returns_all_keys(self, monkeypatch, capsys):
+        status, remaining, _, children = self._run(monkeypatch, [])
+        assert status == "no_ready"
+        assert remaining == ["1", "5"]
+        assert children[0].killed
+
+    def test_stall_after_one_result_blames_in_flight_config(self, monkeypatch, capsys):
+        events = [
+            {"event": "ready", "platform": "tpu", "device_kind": "v5",
+             "devices": 1, "degraded": False},
+            {"event": "result", "config": "1",
+             "metric": bench.CONFIG_META["1"][0], "value": 10.0},
+            # then silence: config 5 is in flight when the chip dies
+        ]
+        status, remaining, r, _ = self._run(monkeypatch, events)
+        assert status == "stalled"
+        assert remaining == ["5"]  # the hung config, first in remaining
+        assert r.results["1"]["value"] == 10.0
+
+    def test_accel_child_on_cpu_routes_to_fallback(self, monkeypatch, capsys):
+        events = [{"event": "ready", "platform": "cpu", "device_kind": "cpu",
+                   "devices": 1, "degraded": True}]
+        status, remaining, r, children = self._run(monkeypatch, events, cpu=False)
+        assert status == "came_up_cpu"
+        assert remaining == ["1", "5"]
+        assert children[0].killed
+        # the summary must NOT claim a cpu platform came up as the accelerator
+        assert r.diag.get("platform") != "cpu"
+
+    def test_clean_completion(self, monkeypatch, capsys):
+        events = [
+            {"event": "ready", "platform": "tpu", "device_kind": "v5",
+             "devices": 1, "degraded": False},
+            {"event": "result", "config": "1",
+             "metric": bench.CONFIG_META["1"][0], "value": 1.0},
+            {"event": "result", "config": "5",
+             "metric": bench.CONFIG_META["5"][0], "value": 2.0},
+            {"event": "done"},
+        ]
+        status, remaining, r, _ = self._run(monkeypatch, events)
+        assert status == "ok" and remaining == []
+        assert set(r.results) == {"1", "5"}
+
+
 class TestConfigTables:
     def test_config_tables_consistent(self):
         assert set(bench.CONFIG_ORDER) == set(bench.CONFIGS) == set(bench.CONFIG_META)
