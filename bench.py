@@ -63,10 +63,14 @@ BASELINES_FILE = os.path.join(_REPO, "BENCH_BASELINES.json")
 # (b) accumulates chunks until min_measured_s of work and >= min_chunks
 # chunks (so a cross-chunk stddev exists). Iterations inside a chunk stay
 # pipelined — no per-iteration sync.
+# The full-path device-loop depth, = ExperimentConfig.loss_fetch_every's
+# default: the bench measures the run() loop's own steady state (round 4
+# raised 32 -> 128; WGAN-GP stays at 32 below — grad-of-grad scan memory).
+FULL_WINDOW = 128
 FULL_OPTS = {
     "warmup": 3, "timed_iters": 20, "min_chunk_s": 1.0, "min_measured_s": 3.0,
     "min_chunks": 3, "max_chunks": 50, "max_iters_per_chunk": 5000,
-    "scan_cap": 64, "cheap": False,
+    "scan_cap": FULL_WINDOW, "cheap": False,
 }
 # CHEAP: degraded-CPU fallback. XLA:CPU compiles the per-dispatch fused step
 # in ~15 s but a scan program in 70-140 s (and then runs it in tens of
@@ -296,14 +300,14 @@ def bench_mnist(diag, opts, deadline):
     bytes); both precisions AND the per-dispatch path are reported when the
     budget allows — the f32 device-loop number alone is enough to headline,
     so the extra variants are budget-gated, not mandatory."""
-    f32 = _bench_experiment("mnist", 64, compute_dtype=None, scan_window=32,
+    f32 = _bench_experiment("mnist", 64, compute_dtype=None, scan_window=FULL_WINDOW,
                             opts=opts, deadline=deadline)
     best, dtype = f32, "f32"
     extras = {}
     cheap = opts["cheap"]
     if not cheap and not (deadline and time.time() > deadline - 30):
         bf16 = _bench_experiment("mnist", 64, compute_dtype="bf16",
-                                 scan_window=32, opts=opts, deadline=deadline)
+                                 scan_window=FULL_WINDOW, opts=opts, deadline=deadline)
         extras["bf16_images_per_sec"] = round(bf16["items_per_sec"], 2)
         extras["bf16_speedup_vs_f32"] = round(
             bf16["items_per_sec"] / f32["items_per_sec"], 3
@@ -315,7 +319,7 @@ def bench_mnist(diag, opts, deadline):
         # 3): the half-the-HBM-bytes lever for this bandwidth-bound config;
         # compute is bf16 too (pure-bf16, zero casts)
         bf16s = _bench_experiment("mnist", 64, param_dtype="bf16",
-                                  compute_dtype="bf16", scan_window=32,
+                                  compute_dtype="bf16", scan_window=FULL_WINDOW,
                                   opts=opts, deadline=deadline)
         extras["bf16_storage_images_per_sec"] = round(bf16s["items_per_sec"], 2)
         extras["bf16_storage_speedup_vs_f32"] = round(
@@ -338,7 +342,7 @@ def bench_mnist_b256(diag, opts, deadline):
     """Config 1b — the capacity point (VERDICT r3 item 6): batch 256 reaches
     ~28% MFU / ~123k img/s on v5e (PROFILE.md batch sweep); a baselined bench
     config regression-guards it, PROFILE.md alone does not."""
-    m = _bench_experiment("mnist", 256, compute_dtype=None, scan_window=32,
+    m = _bench_experiment("mnist", 256, compute_dtype=None, scan_window=FULL_WINDOW,
                           opts=opts, deadline=deadline)
     return {"metric": CONFIG_META["1b"][0], "unit": CONFIG_META["1b"][1],
             "compute_dtype": "f32", **_with_mfu(m, diag)}
@@ -347,7 +351,7 @@ def bench_mnist_b256(diag, opts, deadline):
 def bench_tabular(diag, opts, deadline):
     m = _bench_experiment(
         "tabular", 256, num_features=32, z_size=8, height=1, width=1, channels=1,
-        compute_dtype="bf16", scan_window=32, opts=opts, deadline=deadline,
+        compute_dtype="bf16", scan_window=FULL_WINDOW, opts=opts, deadline=deadline,
     )
     return {"metric": CONFIG_META["2"][0], "unit": CONFIG_META["2"][1],
             "compute_dtype": "bf16", **_with_mfu(m, diag)}
@@ -356,7 +360,7 @@ def bench_tabular(diag, opts, deadline):
 def bench_cifar10(diag, opts, deadline):
     m = _bench_experiment(
         "cifar10", 64, height=32, width=32, channels=3, z_size=64,
-        compute_dtype="bf16", scan_window=32, opts=opts, deadline=deadline,
+        compute_dtype="bf16", scan_window=FULL_WINDOW, opts=opts, deadline=deadline,
     )
     return {"metric": CONFIG_META["3"][0], "unit": CONFIG_META["3"][1],
             "compute_dtype": "bf16", **_with_mfu(m, diag)}
@@ -371,7 +375,7 @@ def bench_celeba64(diag, opts, deadline):
     n = mesh.devices.size
     m = _bench_experiment(
         "celeba64", 8 * n, height=64, width=64, channels=3, z_size=64,
-        distributed="pmean", mesh=mesh, compute_dtype="bf16", scan_window=32,
+        distributed="pmean", mesh=mesh, compute_dtype="bf16", scan_window=FULL_WINDOW,
         opts=opts, deadline=deadline,
     )
     return {"metric": CONFIG_META["4"][0], "unit": CONFIG_META["4"][1],
@@ -390,7 +394,7 @@ def bench_celeba64_avg(diag, opts, deadline):
     m = _bench_experiment(
         "celeba64", 8 * n, height=64, width=64, channels=3, z_size=64,
         distributed="param_averaging", mesh=mesh, compute_dtype="bf16",
-        scan_window=32, opts=opts, deadline=deadline,
+        scan_window=FULL_WINDOW, opts=opts, deadline=deadline,
     )
     return {"metric": CONFIG_META["4b"][0], "unit": CONFIG_META["4b"][1],
             "compute_dtype": "bf16", "devices": n, **_with_mfu(m, diag)}
