@@ -178,3 +178,17 @@ class TestConfigTables:
 
     def test_axon_boot_vars_cover_the_relay_dial(self):
         assert "PALLAS_AXON_POOL_IPS" in bench.AXON_BOOT_VARS
+
+    def test_full_window_is_the_run_loop_steady_state(self):
+        # Three manually-coupled copies of the device-loop depth: the bench
+        # measures run()'s steady state, so FULL_WINDOW must track
+        # ExperimentConfig.loss_fetch_every's default, and scan_cap must not
+        # silently clamp it (a FULL_WINDOW raise that forgets scan_cap would
+        # report device_loop_window == FULL_WINDOW while measuring less).
+        import dataclasses
+
+        from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
+
+        default = {f.name: f.default for f in dataclasses.fields(ExperimentConfig)}
+        assert bench.FULL_WINDOW == default["loss_fetch_every"]
+        assert bench.FULL_OPTS["scan_cap"] >= bench.FULL_WINDOW
