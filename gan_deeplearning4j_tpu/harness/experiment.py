@@ -63,9 +63,12 @@ def shape_struct(tree):
     )
 
 
-def _one_opt_step(graph, opt, state: TrainState, feats, labels, key):
+def _one_opt_step(graph, opt, state: TrainState, feats, labels, key,
+                  lr_scale=None):
     """One optimizer step on one minibatch — the traced core both fused-body
-    builders (GraphTrainer mode and shard_map averaging mode) scan over."""
+    builders (GraphTrainer mode and shard_map averaging mode) scan over.
+    ``lr_scale`` (traced scalar or None) rescales the effective LR — the
+    dis-LR decay schedule's entry point (GraphOptimizer.step)."""
 
     def loss_fn(p):
         loss, (_, new_p) = graph.loss(p, feats, labels, train=True, rng=key)
@@ -74,8 +77,24 @@ def _one_opt_step(graph, opt, state: TrainState, feats, labels, key):
     (loss, new_params), grads = jax.value_and_grad(loss_fn, has_aux=True)(
         state.params
     )
-    params, opt_state = opt.step(new_params, grads, state.opt_state)
+    params, opt_state = opt.step(new_params, grads, state.opt_state,
+                                 lr_scale=lr_scale)
     return TrainState(params, opt_state, state.step + 1), loss
+
+
+def _dis_lr_scale(cfg: ExperimentConfig, dis_step):
+    """The staircase decay factor for the discriminator at a given carried
+    step counter (two dis optimizer steps per alternating iteration, so
+    ``iteration = dis_step // 2``). A traced expression of the step — usable
+    inside jit AND inside the scan device loop, where the iteration advances
+    in-carry; returns None when the schedule is off (zero overhead)."""
+    if not cfg.dis_lr_decay_every or cfg.dis_lr_decay_rate == 1.0:
+        return None
+    iteration = dis_step // 2
+    return jnp.power(
+        jnp.float32(cfg.dis_lr_decay_rate),
+        (iteration // cfg.dis_lr_decay_every).astype(jnp.float32),
+    )
 
 
 def _rebind(src: TrainState, dst: TrainState, mapping) -> TrainState:
@@ -267,6 +286,9 @@ class GanExperiment:
         one_step, rebind = _one_opt_step, _rebind
         z_size = self.model_cfg.z_size
         base_key = jax.random.PRNGKey(self.config.seed + 2)
+        cfg = self.config
+        resample = cfg.resample_label_noise
+        softening = cfg.label_softening
 
         def fused(
             dis_state, gan_state, cv_state, gen_params,
@@ -280,7 +302,24 @@ class GanExperiment:
             # repeat masks forever).
             b = real_f.shape[0]
             key = jax.random.fold_in(base_key, dis_state.step)
-            k_fake, k_gan, k_d1, k_d2, k_g, k_c = jax.random.split(key, 6)
+            if resample:
+                # Per-batch label-noise resampling (the G/D-balance lever,
+                # round-5 VERDICT item 4) derived from the SAME per-step key
+                # stream — fresh ε every iteration with no host round trip,
+                # so the lever works inside the scan device loop too. The
+                # passed soft1/soft0 are ignored. When off, the split stays
+                # 6-way so the reference-quirk RNG stream is bit-identical
+                # to prior rounds.
+                k_fake, k_gan, k_d1, k_d2, k_g, k_c, k_s1, k_s0 = (
+                    jax.random.split(key, 8)
+                )
+                soft1 = 1.0 + softening * jax.random.normal(
+                    k_s1, (b, 1), jnp.float32
+                )
+                soft0 = softening * jax.random.normal(k_s0, (b, 1), jnp.float32)
+            else:
+                k_fake, k_gan, k_d1, k_d2, k_g, k_c = jax.random.split(key, 6)
+            dis_scale = _dis_lr_scale(cfg, dis_state.step)
             z_fake = jax.random.uniform(k_fake, (b, z_size), jnp.float32, -1.0, 1.0)
             z_gan = jax.random.uniform(k_gan, (b, z_size), jnp.float32, -1.0, 1.0)
             # (a) fake batch from the frozen sampler
@@ -288,10 +327,12 @@ class GanExperiment:
             fake = fake.reshape(real_f.shape)
             # (b) dis fit: real→soft1 then fake→soft0, two optimizer steps
             dis_state, d1 = one_step(
-                self.dis, self.dis_trainer.optimizer, dis_state, real_f, soft1, k_d1
+                self.dis, self.dis_trainer.optimizer, dis_state, real_f, soft1,
+                k_d1, lr_scale=dis_scale,
             )
             dis_state, d2 = one_step(
-                self.dis, self.dis_trainer.optimizer, dis_state, fake, soft0, k_d2
+                self.dis, self.dis_trainer.optimizer, dis_state, fake, soft0,
+                k_d2, lr_scale=dis_scale,
             )
             # (c) dis → gan frozen tail
             gan_state = rebind(dis_state, gan_state, self.dis_to_gan)
@@ -361,6 +402,9 @@ class GanExperiment:
         one_step, rebind = _one_opt_step, _rebind
         z_size = self.model_cfg.z_size
         base_key = jax.random.PRNGKey(self.config.seed + 2)
+        cfg = self.config
+        resample = cfg.resample_label_noise
+        softening = cfg.label_softening
 
         def avg(state: TrainState) -> TrainState:
             return TrainState(
@@ -374,11 +418,25 @@ class GanExperiment:
             widx = jax.lax.axis_index(axis)
             b = real_f.shape[0]  # per-worker rows
             key = jax.random.fold_in(base_key, dis_state.step)
-            k_fake, k_gan, k_d1, k_d2, k_g, k_c = jax.random.split(key, 6)
 
             def wkey(k):  # worker-distinct subkey for local draws/dropout
                 return jax.random.fold_in(k, widx)
 
+            if resample:
+                # per-batch ε, worker-distinct rows (each worker softens its
+                # own shard — the phased path's per-row noise layout)
+                k_fake, k_gan, k_d1, k_d2, k_g, k_c, k_s1, k_s0 = (
+                    jax.random.split(key, 8)
+                )
+                soft1 = 1.0 + softening * jax.random.normal(
+                    wkey(k_s1), (b, 1), jnp.float32
+                )
+                soft0 = softening * jax.random.normal(
+                    wkey(k_s0), (b, 1), jnp.float32
+                )
+            else:
+                k_fake, k_gan, k_d1, k_d2, k_g, k_c = jax.random.split(key, 6)
+            dis_scale = _dis_lr_scale(cfg, dis_state.step)
             z_fake = jax.random.uniform(
                 wkey(k_fake), (b, z_size), jnp.float32, -1.0, 1.0
             )
@@ -388,11 +446,11 @@ class GanExperiment:
             # average — the 2-element-List<DataSet> fit boundary
             dis_state, d1 = one_step(
                 self.dis, self.dis_trainer.optimizer, dis_state,
-                real_f, soft1, wkey(k_d1),
+                real_f, soft1, wkey(k_d1), lr_scale=dis_scale,
             )
             dis_state, d2 = one_step(
                 self.dis, self.dis_trainer.optimizer, dis_state,
-                fake, soft0, wkey(k_d2),
+                fake, soft0, wkey(k_d2), lr_scale=dis_scale,
             )
             dis_state = avg(dis_state)
             gan_state = rebind(dis_state, gan_state, self.dis_to_gan)
@@ -503,18 +561,14 @@ class GanExperiment:
         In parameter-averaging mode the scanned body is the shard_map
         per-fit-averaging program (``_build_fused_avg_body``) instead of the
         fused GraphTrainer body — same window contract, faithful averaging
-        semantics. Unavailable with ``resample_label_noise`` (the window
-        shares the once-sampled noise — which is the reference's semantics)
-        and in averaging mode without a mesh."""
+        semantics. With ``resample_label_noise`` the scanned body redraws the
+        softening ε from the per-step key stream each iteration (round 5), so
+        the lever runs at full device-loop speed. Unavailable in averaging
+        mode without a mesh."""
         if not getattr(self, "_supports_device_loop", False):
             raise ValueError(
                 "train_iterations requires the fused path (single-chip, "
                 "per-step pmean, or param_averaging on a mesh)"
-            )
-        if self.config.resample_label_noise:
-            raise ValueError(
-                "train_iterations shares the once-sampled label noise across "
-                "the window; use train_iteration with resample_label_noise"
             )
         with compute_dtype_scope(self._compute_dtype):
             b = int(features.shape[1])
@@ -569,13 +623,10 @@ class GanExperiment:
         real_labels = jnp.asarray(real_labels)
 
         if self._fused is not None:
-            if cfg.resample_label_noise:
-                soft1 = jnp.asarray(1.0 + self._soft_noise(b))
-                soft0 = jnp.asarray(0.0 + self._soft_noise(b))
-            else:
-                # extends the once-sampled noise for oversized batches and
-                # caches the device-resident softened labels per batch size
-                soft1, soft0 = self._soft_labels(b)
+            # once-sampled noise, extended for oversized batches, cached
+            # device-resident per batch size; under resample_label_noise the
+            # fused body redraws ε in-program and ignores these values
+            soft1, soft0 = self._soft_labels(b)
             with self.timer.phase("train_fused"):
                 (
                     self.dis_state,
@@ -794,11 +845,12 @@ class GanExperiment:
         intervene. An export after iteration j needs the state AT j, so an
         export index may only be a window's LAST element; per-iteration
         checkpointing (save_models) forces windows of 1, as do the phased
-        trainer, per-batch label-noise resampling, and loss_fetch_every=1."""
+        trainer and loss_fetch_every=1 (label-noise resampling happens
+        inside the scanned body since round 5, so it no longer forces
+        per-dispatch stepping)."""
         cfg = self.config
         if (
             not getattr(self, "_supports_device_loop", False)  # phased path
-            or cfg.resample_label_noise
             or cfg.save_models
             or cfg.loss_fetch_every <= 1
         ):

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax.numpy as jnp
+
 from gan_deeplearning4j_tpu.ops import clipping
 
 
@@ -53,9 +55,17 @@ class GraphOptimizer:
             }
         return state
 
-    def step(self, params: Dict, grads: Dict, opt_state: Dict) -> Tuple[Dict, Dict]:
+    def step(self, params: Dict, grads: Dict, opt_state: Dict,
+             lr_scale=None) -> Tuple[Dict, Dict]:
         """One update: returns (new_params, new_opt_state). Pure — safe under
-        jit; donate the inputs for in-place HBM reuse."""
+        jit; donate the inputs for in-place HBM reuse.
+
+        ``lr_scale`` (a traced scalar or None) multiplies the final delta.
+        Every in-tree updater's delta is LINEAR in its learning rate (SGD,
+        DL4J-RmsProp, Adam — optim/updaters.py), so scaling the delta is
+        exactly an effective-LR rescale — the mechanism behind the dis-LR
+        decay schedule (ExperimentConfig.dis_lr_decay_*) without baking the
+        rate into the compiled program."""
         if self._clip == "elementwise":
             grads = clipping.clip_elementwise(grads, self._clip_value)
         elif self._clip == "global_norm":
@@ -72,6 +82,10 @@ class GraphOptimizer:
                 if not self.trainable(layer, pname):
                     continue
                 delta, s = updater.apply(layer_state[pname], grads[layer][pname], p)
+                if lr_scale is not None:
+                    # cast to the delta's dtype: an f32 scale on a bf16 delta
+                    # would silently promote params out of bf16 storage
+                    delta = delta * jnp.asarray(lr_scale, delta.dtype)
                 layer_params[pname] = p - delta
                 layer_state[pname] = s
             new_params[layer] = layer_params

@@ -1,0 +1,223 @@
+"""MFU ceiling calibration (round-5 VERDICT item 5).
+
+The bench reports ACHIEVED MFU for the full alternating iteration (optimizer
+steps, weight syncs, BN state carry, loss plumbing included). Whether 26% at
+batch 256 is "the ceiling" or leaves something on the table was, until this
+script, an inference from the roofline model (PROFILE.md: arithmetic
+intensity 15-17 vs ridge ~240 → bandwidth-bound). This measures it instead,
+at three tiers on the same device:
+
+1. ``gemm``: a large square bf16 matmul in a scan loop — what the MXU
+   delivers at its friendliest shape; sanity-pins the peak-FLOPS constant
+   the MFU denominator uses (PEAK_FLOPS_BY_KIND in bench.py).
+2. ``bare:<config>``: the SAME conv/GEMM work as bench config 1/1b — the
+   sampler forward plus fwd+bwd through dis(×2)/gan/cv at identical batch
+   shapes — in a bare ``lax.scan`` with NO optimizer step, NO updater state,
+   NO weight syncs, NO BN running-stats carry. Gradients stay live via an
+   epsilon pseudo-update (XLA would dead-code-eliminate an unconsumed
+   backward pass). This is the attainable MFU at these model shapes.
+3. achieved: read from artifacts/benchmarks.json when present, so the
+   report carries attainable-vs-achieved side by side.
+
+Writes ``artifacts/mfu_ceiling.json``. Run on the real chip; ``--cpu``
+exists only to smoke-test the code path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import PEAK_FLOPS_BY_KIND, _peak_flops  # noqa: E402  (jax-free import)
+
+SCAN_K = 128  # match the bench's device-loop window
+
+
+def _timed_calls(fn, sync, *, min_s=3.0, max_calls=50) -> float:
+    """Median seconds per call over enough calls to cover ``min_s``."""
+    fn(); sync()  # warmup/compile
+    times = []
+    while sum(times) < min_s and len(times) < max_calls:
+        t0 = time.perf_counter()
+        fn()
+        sync()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_gemm(n: int, dtype, peak) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.asarray(np.random.default_rng(0).standard_normal((n, n)), dtype)
+    b = jnp.asarray(np.random.default_rng(1).standard_normal((n, n)), dtype)
+
+    @jax.jit
+    def loop(a, b):
+        def step(carry, _):
+            # rebind so the K matmuls chain (no DCE, no hoisting)
+            return jnp.tanh(carry @ b) * 1e-3 + a * 1e-6, ()
+
+        out, _ = jax.lax.scan(step, a, None, length=SCAN_K)
+        return out
+
+    out = loop(a, b)
+    sec_per_call = _timed_calls(
+        lambda: loop(a, b), lambda: np.asarray(out[0, 0]), min_s=2.0
+    )
+    # one n×n×n matmul = 2n³ FLOPs, K per call (tanh/scale are O(n²) noise)
+    flops_per_call = 2.0 * n**3 * SCAN_K
+    tflops = flops_per_call / sec_per_call / 1e12
+    return {
+        "n": n, "dtype": str(dtype.dtype if hasattr(dtype, "dtype") else dtype),
+        "sec_per_matmul": sec_per_call / SCAN_K,
+        "tflops": round(tflops, 2),
+        "frac_of_peak": round(flops_per_call / (sec_per_call * peak), 4)
+        if peak else None,
+    }
+
+
+def bench_bare(batch: int, peak) -> dict:
+    """The fused iteration's compute core at config-1 shapes, bookkeeping
+    stripped (see module docstring tier 2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+
+    cfg = ExperimentConfig(
+        batch_size_train=batch, batch_size_pred=batch,
+        num_iterations=2, save_models=False,
+    )
+    exp = GanExperiment(cfg)
+    rng = np.random.default_rng(0)
+    feats = jnp.asarray(rng.random((batch, 784), dtype=np.float32))
+    labels = jnp.asarray(
+        np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    )
+    soft1 = jnp.ones((batch, 1), jnp.float32)
+    soft0 = jnp.zeros((batch, 1), jnp.float32)
+    ones = jnp.ones((batch, 1), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    dis, gan, cv, gen = exp.dis, exp.gan, exp.cv, exp.gen
+    z_size = cfg.z_size
+
+    def grad_of(graph, params, f, l, k):
+        def loss_fn(p):
+            loss, _ = graph.loss(p, f, l, train=True, rng=k)
+            return loss
+
+        return jax.grad(loss_fn)(params)
+
+    def pseudo(params, grads):
+        # epsilon update: keeps every gradient live at O(bytes) cost —
+        # the optimizer's memory traffic without its update math
+        return jax.tree_util.tree_map(lambda p, g: p - 1e-12 * g, params, grads)
+
+    @jax.jit
+    def loop(dis_p, gan_p, cv_p, gen_p):
+        def step(carry, t):
+            dis_p, gan_p, cv_p, gen_p = carry
+            # per-step key + a per-step gen_p nudge: both are required to
+            # keep the sampler forward INSIDE the scan — with loop-invariant
+            # gen_p and key, XLA hoists the whole generator out and the
+            # "ceiling" silently drops a model's worth of FLOPs
+            ks = jax.random.split(jax.random.fold_in(key, t), 6)
+            z = jax.random.uniform(ks[0], (batch, z_size), jnp.float32, -1.0, 1.0)
+            fake = gen.output(gen_p, z, train=False).reshape(feats.shape)
+            dis_p = pseudo(dis_p, grad_of(dis, dis_p, feats, soft1, ks[1]))
+            dis_p = pseudo(dis_p, grad_of(dis, dis_p, fake, soft0, ks[2]))
+            z2 = jax.random.uniform(ks[3], (batch, z_size), jnp.float32, -1.0, 1.0)
+            g_gan = grad_of(gan, gan_p, z2, ones, ks[4])
+            gan_p = pseudo(gan_p, g_gan)
+            nudge = 1e-12 * jnp.sum(jax.tree_util.tree_leaves(g_gan)[0])
+            gen_p = jax.tree_util.tree_map(lambda p: p - nudge, gen_p)
+            cv_p = pseudo(cv_p, grad_of(cv, cv_p, feats, labels, ks[5]))
+            return (dis_p, gan_p, cv_p, gen_p), ()
+
+        carry, _ = jax.lax.scan(
+            step,
+            (dis_p, gan_p, cv_p, gen_p),
+            jnp.arange(SCAN_K),
+        )
+        return carry
+
+    args = (exp.dis_state.params, exp.gan_state.params,
+            exp.cv_state.params, exp.gen_params)
+    cost = loop.lower(*args).compile().cost_analysis()
+    flops_per_call = float(cost["flops"]) if cost and "flops" in cost else None
+    out = loop(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    sec_per_call = _timed_calls(
+        lambda: loop(*args), lambda: np.asarray(leaf).ravel()[:1], min_s=3.0
+    )
+    sec_per_iter = sec_per_call / SCAN_K
+    mfu = None
+    if peak and flops_per_call:
+        mfu = flops_per_call / (sec_per_call * peak)
+    return {
+        "batch": batch,
+        "sec_per_iter": round(sec_per_iter, 6),
+        "flops_per_iter": (
+            flops_per_call / SCAN_K if flops_per_call else None
+        ),
+        "images_per_sec": round(batch / sec_per_iter, 2),
+        "bare_mfu": round(mfu, 4) if mfu is not None else None,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="artifacts/mfu_ceiling.json")
+    ap.add_argument("--gemm-n", type=int, default=4096)
+    ap.add_argument("--batches", default="64,256")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    report = {
+        "platform": jax.default_backend(),
+        "device_kind": kind,
+        "peak_flops_assumed": peak,
+        "scan_window": SCAN_K,
+        "gemm": bench_gemm(args.gemm_n, jnp.bfloat16, peak),
+        "bare": {},
+    }
+    print(json.dumps({"gemm": report["gemm"]}), flush=True)
+    for b in [int(x) for x in args.batches.split(",")]:
+        report["bare"][str(b)] = bench_bare(b, peak)
+        print(json.dumps({f"bare_b{b}": report["bare"][str(b)]}), flush=True)
+
+    # achieved (full-iteration) MFU from the bench artifact, when present
+    try:
+        with open("artifacts/benchmarks.json") as fh:
+            rs = {r.get("config"): r for r in json.load(fh)["results"]}
+        report["achieved"] = {
+            "1": rs.get("1", {}).get("mfu"),
+            "1b": rs.get("1b", {}).get("mfu"),
+        }
+    except (OSError, ValueError, KeyError):
+        report["achieved"] = None
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2)
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,13 @@ def main() -> int:
     ap.add_argument("--out", default="artifacts")
     ap.add_argument("--export-every", type=int, default=50)
     ap.add_argument("--compute-dtype", default=None)
+    # G/D-balance levers (round-5 VERDICT item 4) — set by the campaign's
+    # sweep selector; defaults preserve the reference configuration
+    ap.add_argument("--resample-label-noise", action="store_true")
+    ap.add_argument("--dis-lr-decay-every", type=int, default=0)
+    ap.add_argument("--dis-lr-decay-rate", type=float, default=1.0)
+    ap.add_argument("--dis-lr", type=float, default=0.002)
+    ap.add_argument("--gen-lr", type=float, default=0.004)
     ap.add_argument("--cpu", action="store_true", help="force the host backend")
     ap.add_argument("--seed", type=int, default=666)
     ap.add_argument("--no-select-best", action="store_true",
@@ -100,6 +107,11 @@ def main() -> int:
         save_models=False,  # checkpoint once at the end, not per iteration
         output_dir=args.out,
         compute_dtype=args.compute_dtype,
+        resample_label_noise=args.resample_label_noise,
+        dis_lr_decay_every=args.dis_lr_decay_every,
+        dis_lr_decay_rate=args.dis_lr_decay_rate,
+        dis_learning_rate=args.dis_lr,
+        gen_learning_rate=args.gen_lr,
         seed=args.seed,
     )
     exp = GanExperiment(cfg)
@@ -290,6 +302,13 @@ def main() -> int:
         "iterations": result["iterations"],
         "batch_size": args.batch,
         "compute_dtype": args.compute_dtype or "f32",
+        "levers": {
+            "resample_label_noise": args.resample_label_noise,
+            "dis_lr_decay_every": args.dis_lr_decay_every,
+            "dis_lr_decay_rate": args.dis_lr_decay_rate,
+            "dis_lr": args.dis_lr,
+            "gen_lr": args.gen_lr,
+        },
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "accuracy": round(float(acc), 4),

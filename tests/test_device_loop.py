@@ -67,11 +67,96 @@ class TestTrainIterations:
                 )
         assert int(scan.dis_state.step) == int(seq.dis_state.step) == 2 * K
 
-    def test_requires_fused_path(self):
-        exp = GanExperiment(_cfg(resample_label_noise=True))
+    def test_resample_label_noise_runs_in_device_loop(self):
+        # Round 5: the G/D-balance lever no longer forces per-dispatch
+        # stepping — the scanned body redraws ε from the per-step key stream.
         feats, labels = _data(2)
-        with pytest.raises(ValueError, match="label noise"):
-            exp.train_iterations(feats, labels)
+        exp = GanExperiment(_cfg(resample_label_noise=True))
+        out = exp.train_iterations(feats, labels)
+        assert out["d_loss"].shape == (2,)
+        # and the scan matches sequential fused calls bit-for-bit in loss
+        # order (same body, same key stream)
+        seq = GanExperiment(_cfg(resample_label_noise=True))
+        seq_d = [float(seq.train_iteration(feats[i], labels[i])["d_loss"])
+                 for i in range(2)]
+        np.testing.assert_allclose(
+            np.asarray(out["d_loss"]), seq_d, rtol=2e-5, atol=1e-6
+        )
+
+    def test_resampled_noise_differs_per_iteration(self):
+        # With the quirk disabled, two iterations on IDENTICAL data must see
+        # different softened labels — observable as different d_losses even
+        # when dropout/z are the only other variation... so compare against
+        # the quirk path where the same check uses identical noise: the
+        # resampled run's dis updates diverge from the once-sampled run's
+        # from iteration 1 onward.
+        feats, labels = _data(1)
+        feats = np.broadcast_to(feats, (2,) + feats.shape[1:]).copy()
+        labels = np.broadcast_to(labels, (2,) + labels.shape[1:]).copy()
+        quirk = GanExperiment(_cfg(seed=1))
+        fresh = GanExperiment(_cfg(seed=1, resample_label_noise=True))
+        dq = np.asarray(quirk.train_iterations(feats, labels)["d_loss"])
+        df = np.asarray(fresh.train_iterations(feats, labels)["d_loss"])
+        assert not np.allclose(dq, df)
+
+    def test_dis_lr_decay_freezes_dis_at_rate_epsilon(self):
+        # rate ≈ 0 with every=1: iteration 0 runs at scale 1 (γ^0), every
+        # later iteration's dis update is scaled to ~nothing — dis params
+        # stop moving while gen keeps training. Pins both the schedule
+        # boundary (floor(iter/every)) and that the scale reaches ONLY dis.
+        feats, labels = _data(3)
+        exp = GanExperiment(_cfg(
+            dis_lr_decay_every=1, dis_lr_decay_rate=1e-30,
+        ))
+
+        def trainable_dis(params):
+            # BN running stats (role "state") update through the training
+            # forward pass regardless of LR — compare optimizer-owned
+            # leaves only
+            opt = exp.dis_trainer.optimizer
+            return {
+                layer: {p: np.asarray(v).copy()
+                        for p, v in lparams.items() if opt.trainable(layer, p)}
+                for layer, lparams in params.items()
+            }
+
+        exp.train_iteration(feats[0], labels[0])
+        dis_after_1 = trainable_dis(exp.dis_state.params)
+        gen_after_1 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x).copy(), exp.gen_params
+        )
+        exp.train_iteration(feats[1], labels[1])
+        for a, b in zip(jax.tree_util.tree_leaves(dis_after_1),
+                        jax.tree_util.tree_leaves(
+                            trainable_dis(exp.dis_state.params))):
+            np.testing.assert_allclose(a, b, rtol=0, atol=1e-6)
+        assert any(
+            not np.allclose(a, np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(gen_after_1),
+                            jax.tree_util.tree_leaves(exp.gen_params))
+        )
+
+    def test_dis_lr_decay_identical_in_scan_and_sequential(self):
+        feats, labels = _data(3)
+        kw = dict(dis_lr_decay_every=2, dis_lr_decay_rate=0.5)
+        seq = GanExperiment(_cfg(**kw))
+        seq_d = [float(seq.train_iteration(feats[i], labels[i])["d_loss"])
+                 for i in range(3)]
+        scan = GanExperiment(_cfg(**kw))
+        out = scan.train_iterations(feats, labels)
+        np.testing.assert_allclose(
+            np.asarray(out["d_loss"]), seq_d, rtol=2e-5, atol=1e-6
+        )
+
+    def test_dis_lr_decay_off_is_bit_identical_to_round4_stream(self):
+        # the default config must keep the 6-way key split — decay/resample
+        # OFF may not perturb the established RNG stream or update math
+        feats, labels = _data(2)
+        base = GanExperiment(_cfg())
+        d0 = np.asarray(base.train_iterations(feats, labels)["d_loss"])
+        noop = GanExperiment(_cfg(dis_lr_decay_every=0, dis_lr_decay_rate=0.9))
+        d1 = np.asarray(noop.train_iterations(feats, labels)["d_loss"])
+        np.testing.assert_array_equal(d0, d1)
 
     def test_losses_stay_on_device(self):
         exp = GanExperiment(_cfg())
