@@ -15,19 +15,49 @@ parameter averaging + cuDNN kernels), re-designed TPU-first:
   dl4jGANComputerVision.java:317-330);
 - device-resident data pipeline, checkpointing with updater state, and an
   alternating GAN training harness (reference: dl4jGANComputerVision.java:408-621).
+
+The top-level namespace is LAZY (PEP 562): importing the package must not
+import jax. Two consumers depend on that — bench.py's parent process (which
+must stay killable while a dead chip can hang ``import jax`` inside native
+code for minutes) and the jaxlint analyzer
+(``python -m gan_deeplearning4j_tpu.analysis``), which has to run in any
+container regardless of the installed accelerator stack. Submodule imports
+(``from gan_deeplearning4j_tpu.harness import ...``) behave exactly as
+before; only the convenience re-exports below defer.
 """
 
 __version__ = "0.1.0"
 
-from gan_deeplearning4j_tpu.runtime.environment import TpuEnvironment, backend_info
-from gan_deeplearning4j_tpu.runtime import factory
-from gan_deeplearning4j_tpu.runtime.dtype import get_default_dtype, set_default_dtype
+# name -> (module to import, attribute to take from it; None = the module)
+_LAZY_EXPORTS = {
+    "TpuEnvironment": ("gan_deeplearning4j_tpu.runtime.environment",
+                       "TpuEnvironment"),
+    "backend_info": ("gan_deeplearning4j_tpu.runtime.environment",
+                     "backend_info"),
+    "factory": ("gan_deeplearning4j_tpu.runtime.factory", None),
+    "get_default_dtype": ("gan_deeplearning4j_tpu.runtime.dtype",
+                          "get_default_dtype"),
+    "set_default_dtype": ("gan_deeplearning4j_tpu.runtime.dtype",
+                          "set_default_dtype"),
+}
 
-__all__ = [
-    "TpuEnvironment",
-    "backend_info",
-    "factory",
-    "get_default_dtype",
-    "set_default_dtype",
-    "__version__",
-]
+__all__ = [*_LAZY_EXPORTS, "__version__"]
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    module = importlib.import_module(module_name)
+    value = module if attr is None else getattr(module, attr)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted({*globals(), *_LAZY_EXPORTS})

@@ -1,0 +1,53 @@
+"""jaxlint — in-tree static analysis for JAX/TPU training hazards.
+
+Rounds 3-5 lost on-chip evidence to bug classes that are mechanically
+detectable at the AST level: a timing harness fencing on a stale output
+(round 5, ``scripts/mfu_ceiling.py``), protocol guards written as bare
+``assert`` (stripped under ``python -O``), and PRNG/jit hygiene that only a
+human reviewer audited. This package turns those review rules into code.
+
+Deliberately jax-free and stdlib-only: the analyzer must run on the parent
+side of the bench architecture (bench.py's parent never imports jax — a dead
+chip can hang ``import jax`` for minutes) and in any CI container regardless
+of which accelerator stack is installed.
+
+Public surface:
+
+- :func:`analyze_paths` / :func:`analyze_source` — run all rules, return
+  :class:`Report` (findings partitioned into active / suppressed /
+  baselined).
+- :class:`Finding` — one diagnostic, with a content-based fingerprint that
+  is stable across line-number drift (rule code + path + normalized source
+  line), so baselines survive unrelated edits.
+- :data:`RULES` — the rule registry (JG001-JG006; see
+  ``docs/STATIC_ANALYSIS.md`` for the catalogue and the real bug behind
+  each rule).
+- CLI: ``python -m gan_deeplearning4j_tpu.analysis <paths>`` — exit 0 iff
+  the tree is clean modulo the checked-in baseline
+  (``analysis/_baseline.json``). A tier-1 test
+  (``tests/test_analysis.py::test_tree_is_clean``) holds that invariant.
+
+Suppression: a trailing ``# jaxlint: disable=JG001`` (comma-separated codes,
+or ``all``) on any line of the offending statement suppresses the finding;
+suppressions are counted and reported, never silent.
+"""
+
+from gan_deeplearning4j_tpu.analysis.engine import (
+    DEFAULT_BASELINE_PATH,
+    Finding,
+    Report,
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+)
+from gan_deeplearning4j_tpu.analysis.rules import RULES
+
+__all__ = [
+    "DEFAULT_BASELINE_PATH",
+    "Finding",
+    "Report",
+    "RULES",
+    "analyze_paths",
+    "analyze_source",
+    "load_baseline",
+]

@@ -1,0 +1,102 @@
+"""jaxlint CLI.
+
+    python -m gan_deeplearning4j_tpu.analysis gan_deeplearning4j_tpu bench.py scripts
+
+Exit codes: 0 clean (modulo baseline + suppressions), 1 active findings or
+stale baseline entries, 2 usage error. ``--format json`` emits one machine-
+readable object; default text output is one ``path:line:col: CODE message``
+row per finding — the same shape compiler diagnostics use, so editors and CI
+annotate it for free.
+
+``--write-baseline`` snapshots the CURRENT active findings into the baseline
+file with a placeholder justification that the loader will refuse until a
+human edits it — regenerating a baseline is deliberately a two-step act.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from gan_deeplearning4j_tpu.analysis import engine
+from gan_deeplearning4j_tpu.analysis.rules import RULES
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m gan_deeplearning4j_tpu.analysis",
+        description="jaxlint: static analysis for JAX/TPU training hazards",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to analyze")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--baseline", default=engine.DEFAULT_BASELINE_PATH,
+                   help="baseline file (default: the checked-in "
+                        "analysis/_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="snapshot current active findings into --baseline "
+                        "with TODO justifications (edit before committing)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.code}  {r.name}: {r.summary}")
+        return 0
+    if not args.paths:
+        p.error("no paths given")
+
+    rules = RULES
+    if args.rules:
+        wanted = {c.strip().upper() for c in args.rules.split(",")}
+        rules = [r for r in RULES if r.code in wanted]
+        unknown = wanted - {r.code for r in rules}
+        if unknown:
+            p.error(f"unknown rule codes: {sorted(unknown)}")
+
+    try:
+        baseline = None if args.no_baseline else engine.load_baseline(args.baseline)
+    except ValueError as exc:
+        print(f"jaxlint: {exc}", file=sys.stderr)
+        return 2
+
+    try:
+        report = engine.analyze_paths(args.paths, rules=rules, baseline=baseline)
+    except FileNotFoundError as exc:
+        print(f"jaxlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        entries = [
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.code,
+                "path": f.path,
+                "line": f.line,
+                "snippet": f.snippet,
+                "justification": "TODO: justify or fix",
+            }
+            for f in report.active
+        ]
+        with open(args.baseline, "w") as fh:
+            json.dump({"entries": entries}, fh, indent=2)
+            fh.write("\n")
+        print(f"jaxlint: wrote {len(entries)} entries to {args.baseline} — "
+              f"replace every TODO justification before committing",
+              file=sys.stderr)
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        print(report.render_text())
+    return 0 if report.clean and not report.stale_baseline else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,348 @@
+"""jaxlint engine: file walking, suppression comments, baseline, reporting.
+
+Fingerprints are content-based — ``sha1(rule|path|normalized source line)``
+— so a baseline entry survives unrelated edits that shift line numbers, and
+goes stale (reported as such) the moment the offending line itself changes.
+Every baseline entry must carry a human ``justification``; the engine
+refuses entries without one, so "baseline it" can never silently become
+"ignore it".
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Iterable, List, Optional
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__), "_baseline.json")
+
+# directories never worth descending into
+_SKIP_DIRS = {".git", "__pycache__", ".jax_cache", "artifacts", ".pytest_cache",
+              "node_modules", ".eggs", "build", "dist"}
+
+_SUPPRESS_RE = re.compile(r"jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path`` is the engine-relative path (repo-relative
+    when run from the repo root — the convention the checked-in baseline
+    and the tier-1 test both use)."""
+
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+    snippet: str
+
+    @property
+    def fingerprint(self) -> str:
+        norm = " ".join(self.snippet.split())
+        digest = hashlib.sha1(
+            f"{self.code}|{self.path}|{norm}".encode()
+        ).hexdigest()
+        return digest[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def to_json(self) -> dict:
+        return {**dataclasses.asdict(self), "fingerprint": self.fingerprint}
+
+
+@dataclasses.dataclass
+class SourceModule:
+    """Parsed module handed to every rule."""
+
+    path: str
+    text: str
+    tree: ast.AST
+    lines: List[str]
+    suppressions: dict  # line number -> set of codes (or {"all"})
+    imports: dict  # local name -> dotted prefix (see _common.build_import_map)
+    is_test: bool
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        return _common.resolve_name(node, self.imports)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, code: str, message: str, node: ast.AST) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            code=code,
+            message=message,
+            path=self.path,
+            line=lineno,
+            col=col,
+            snippet=self.line_text(lineno).strip(),
+        )
+
+    def suppressed(self, finding: Finding, node: ast.AST = None) -> bool:
+        """A ``# jaxlint: disable=JG00x`` on the finding's line — or, when
+        the node spans several physical lines, any line of the span."""
+        start = finding.line
+        end = getattr(node, "end_lineno", None) or start
+        for ln in range(start, end + 1):
+            codes = self.suppressions.get(ln)
+            if codes and ("all" in codes or finding.code in codes):
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class Report:
+    """Partitioned analysis result. ``active`` is what gates CI."""
+
+    active: List[Finding]
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    stale_baseline: List[dict]  # baseline entries that matched nothing
+    files: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.active
+
+    def to_json(self) -> dict:
+        return {
+            "clean": self.clean,
+            "files": self.files,
+            "active": [f.to_json() for f in self.active],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "baselined": [f.to_json() for f in self.baselined],
+            "stale_baseline": self.stale_baseline,
+        }
+
+    def render_text(self) -> str:
+        out = [f.render() for f in self.active]
+        for entry in self.stale_baseline:
+            out.append(
+                f"# stale baseline entry {entry.get('fingerprint')} "
+                f"({entry.get('rule')} {entry.get('path')}) — offending line "
+                f"changed or was fixed; remove it from the baseline"
+            )
+        out.append(
+            f"# jaxlint: {self.files} files, {len(self.active)} active, "
+            f"{len(self.suppressed)} suppressed, "
+            f"{len(self.baselined)} baselined"
+        )
+        return "\n".join(out)
+
+
+def _scan_suppressions(text: str) -> dict:
+    """Line -> codes from ``# jaxlint: disable=...`` comments, via tokenize
+    (comments only — the pattern inside a string literal does not count);
+    regex fallback for files tokenize rejects."""
+    supp: dict = {}
+
+    def record(lineno: int, raw: str) -> None:
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            return
+        codes = {c.strip().upper() if c.strip().lower() != "all" else "all"
+                 for c in m.group(1).split(",") if c.strip()}
+        if codes:
+            supp.setdefault(lineno, set()).update(codes)
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                record(tok.start[0], tok.string)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(text.splitlines(), 1):
+            if "#" in line:
+                record(i, line[line.index("#"):])
+    return supp
+
+
+def _looks_like_test(relpath: str) -> bool:
+    parts = relpath.replace(os.sep, "/").split("/")
+    base = parts[-1]
+    return (
+        "tests" in parts[:-1]
+        or base.startswith("test_")
+        or base == "conftest.py"
+    )
+
+
+def parse_module(text: str, relpath: str, is_test: Optional[bool] = None):
+    """SourceModule, or a parse-failure Finding (code JG000)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as exc:
+        return Finding(
+            code="JG000",
+            message=f"could not parse: {exc.msg}",
+            path=relpath,
+            line=exc.lineno or 1,
+            col=exc.offset or 0,
+            snippet="",
+        )
+    return SourceModule(
+        path=relpath,
+        text=text,
+        tree=tree,
+        lines=text.splitlines(),
+        suppressions=_scan_suppressions(text),
+        imports=_common.build_import_map(tree),
+        is_test=_looks_like_test(relpath) if is_test is None else is_test,
+    )
+
+
+def collect_files(paths: Iterable[str], root: Optional[str] = None) -> List[str]:
+    """Expand files/directories into a sorted list of .py paths, relative to
+    ``root`` (default: cwd) when possible — relative paths keep fingerprints
+    machine-independent. A path that is neither an existing directory nor an
+    existing ``.py`` file raises: a typo in a CI invocation must fail the
+    gate loudly, not shrink it to the paths that happened to resolve."""
+    root = os.path.abspath(root or os.getcwd())
+    found = []
+    for p in paths:
+        ap = os.path.abspath(os.path.join(root, p) if not os.path.isabs(p) else p)
+        if not (os.path.isdir(ap) or (os.path.isfile(ap) and ap.endswith(".py"))):
+            raise FileNotFoundError(
+                f"jaxlint target {p!r} is neither a directory nor an "
+                f"existing .py file (resolved to {ap})"
+            )
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        found.append(os.path.join(dirpath, fn))
+        elif ap.endswith(".py"):
+            found.append(ap)
+    rel = []
+    for ap in found:
+        try:
+            rp = os.path.relpath(ap, root)
+        except ValueError:  # different drive (windows) — keep absolute
+            rp = ap
+        rel.append(rp if not rp.startswith("..") else ap)
+    return sorted(set(rel))
+
+
+def load_baseline(path: Optional[str] = None) -> List[dict]:
+    """Baseline entries (list of dicts). Every entry MUST have fingerprint +
+    justification; malformed entries raise — a baseline that cannot explain
+    itself is worse than none."""
+    path = path or DEFAULT_BASELINE_PATH
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return []
+    entries = data.get("entries", []) if isinstance(data, dict) else data
+    for e in entries:
+        if not e.get("fingerprint") or not str(e.get("justification", "")).strip():
+            raise ValueError(
+                f"baseline entry {e!r} in {path} lacks a fingerprint or a "
+                f"justification — every baselined finding must say why"
+            )
+    return entries
+
+
+def _run_rules(mod: SourceModule, rules) -> List[tuple]:
+    """[(finding, node)] for one module, rule errors converted to findings
+    (an analyzer crash must be visible, not a silent pass)."""
+    out = []
+    for rule in rules:
+        if mod.is_test and getattr(rule, "skip_tests", False):
+            continue
+        try:
+            for item in rule.check(mod):
+                if isinstance(item, tuple):
+                    out.append(item)
+                else:
+                    out.append((item, None))
+        except Exception as exc:  # pragma: no cover - rule bug guard
+            out.append((
+                Finding(
+                    code="JG000",
+                    message=(
+                        f"rule {rule.code} crashed on this file: "
+                        f"{type(exc).__name__}: {exc}"
+                    ),
+                    path=mod.path,
+                    line=1,
+                    col=0,
+                    snippet="",
+                ),
+                None,
+            ))
+    return out
+
+
+def analyze_modules(mods, rules=None, baseline=None) -> Report:
+    from gan_deeplearning4j_tpu.analysis.rules import RULES
+
+    rules = RULES if rules is None else rules
+    baseline = baseline or []
+    by_fp = {e["fingerprint"]: e for e in baseline}
+    matched_fps = set()
+    active, suppressed, baselined = [], [], []
+    seen = set()  # scope overlap can surface one defect twice — keep first
+    files = 0
+    for mod in mods:
+        files += 1
+        if isinstance(mod, Finding):  # parse failure
+            active.append(mod)
+            continue
+        for finding, node in _run_rules(mod, rules):
+            key = (finding.code, finding.path, finding.line, finding.col)
+            if key in seen:
+                continue
+            seen.add(key)
+            if mod.suppressed(finding, node):
+                suppressed.append(finding)
+            elif finding.fingerprint in by_fp:
+                matched_fps.add(finding.fingerprint)
+                baselined.append(finding)
+            else:
+                active.append(finding)
+    stale = [e for e in baseline if e["fingerprint"] not in matched_fps]
+    active.sort(key=lambda f: (f.path, f.line, f.code))
+    return Report(active, suppressed, baselined, stale, files)
+
+
+def analyze_paths(paths, rules=None, baseline=None, root=None) -> Report:
+    """Analyze files/directories. ``baseline`` is a loaded entry list (use
+    :func:`load_baseline`), or None for no baseline."""
+    root = os.path.abspath(root or os.getcwd())
+
+    def gen():
+        for rp in collect_files(paths, root):
+            ap = rp if os.path.isabs(rp) else os.path.join(root, rp)
+            try:
+                with open(ap, encoding="utf-8", errors="replace") as fh:
+                    text = fh.read()
+            except OSError as exc:
+                yield Finding("JG000", f"unreadable: {exc}", rp, 1, 0, "")
+                continue
+            yield parse_module(text, rp)
+
+    return analyze_modules(gen(), rules=rules, baseline=baseline)
+
+
+def analyze_source(text: str, path: str = "<string>", rules=None,
+                   baseline=None, is_test=None) -> Report:
+    """Analyze one in-memory module — the fixture entry point for tests.
+    ``is_test=None`` derives test-ness from ``path`` like the file walker."""
+    mod = parse_module(text, path, is_test=is_test)
+    return analyze_modules([mod], rules=rules, baseline=baseline)

@@ -1,0 +1,33 @@
+"""jaxlint rule registry.
+
+Each rule is a small object with ``code``, ``name``, ``summary`` and a
+``check(mod) -> iterable`` yielding :class:`~..engine.Finding` (or
+``(finding, node)`` tuples when multi-line suppression spans matter).
+``skip_tests = True`` exempts test modules (tests legitimately assert).
+
+The catalogue, with the real bug behind each rule, lives in
+``docs/STATIC_ANALYSIS.md``. New rules: add a module here, register the
+instance in RULES, and give it true-positive/true-negative fixtures in
+``tests/test_analysis.py`` — a rule without a fixture proving it fires on
+the bug it was derived from is not a rule, it is a hope.
+"""
+
+from gan_deeplearning4j_tpu.analysis.rules.prng import PrngKeyReuse
+from gan_deeplearning4j_tpu.analysis.rules.timing import StaleFenceTiming
+from gan_deeplearning4j_tpu.analysis.rules.asserts import BareAssert
+from gan_deeplearning4j_tpu.analysis.rules.recompile import RecompilationHazard
+from gan_deeplearning4j_tpu.analysis.rules.host_sync import HostSyncInTracedCode
+from gan_deeplearning4j_tpu.analysis.rules.donation import DonationSafety
+
+RULES = [
+    PrngKeyReuse(),
+    StaleFenceTiming(),
+    BareAssert(),
+    RecompilationHazard(),
+    HostSyncInTracedCode(),
+    DonationSafety(),
+]
+
+RULES_BY_CODE = {r.code: r for r in RULES}
+
+__all__ = ["RULES", "RULES_BY_CODE"]

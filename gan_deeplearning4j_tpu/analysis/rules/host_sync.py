@@ -1,0 +1,135 @@
+"""JG005 — implicit host sync / trace-time leak inside traced code.
+
+Inside a jitted function or a ``lax.scan``/``while_loop``/``cond`` body,
+values are tracers. Host-crossing operations there are either a
+``ConcretizationTypeError`` (``float()``, ``int()``, ``.item()``,
+``np.asarray`` on a traced value) or — worse — silently wrong: ``print``
+executes ONCE at trace time showing a tracer repr, then never again, which
+is exactly how debugging leftovers masquerade as per-step logging. On the
+tunneled axon platform an accidental device->host read also serializes the
+pipeline the whole bench architecture exists to keep full.
+
+Traced bodies are found syntactically: defs decorated with ``jax.jit`` /
+``jax.pmap`` (directly or via ``functools.partial``), functions or lambdas
+passed to ``jax.jit``/``jax.pmap``/``jax.grad``/``jax.vmap`` or to
+``jax.lax`` control-flow combinators (``scan``, ``while_loop``,
+``fori_loop``, ``cond``, ``switch``, ``map``, ``associative_scan``), plus
+every def nested inside one. Shape arithmetic is exempt: ``int(x.shape[0])``
+and friends are static under tracing and idiomatic.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_TRACING_WRAPPERS = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian", "jax.checkpoint", "jax.remat",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.custom_jvp", "jax.custom_vjp",
+}
+_HOST_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "numpy.save", "numpy.savez", "jax.device_get",
+}
+_HOST_METHODS = {"item", "tolist"}
+_CASTS = {"float", "int", "bool", "complex"}
+# attribute/function sniffs that mark an expression as static shape math
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+
+
+def _is_tracing_wrapper(node: ast.AST, mod) -> bool:
+    resolved = mod.resolve(node)
+    if resolved in _TRACING_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        r = mod.resolve(node.func)
+        if r in _TRACING_WRAPPERS:
+            return True
+        if r == "functools.partial" and node.args:
+            return mod.resolve(node.args[0]) in _TRACING_WRAPPERS
+    return False
+
+
+def _static_shape_expr(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return True
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            return True
+    return False
+
+
+class HostSyncInTracedCode:
+    code = "JG005"
+    name = "host-sync-in-traced-code"
+    summary = ("host-crossing call (print/float/.item/np.asarray) inside a "
+               "jit or lax control-flow body")
+
+    def check(self, mod):
+        traced = self._traced_functions(mod)
+        reported = set()
+        for fn in traced:
+            body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+            for stmt in body:
+                for n in ast.walk(stmt):
+                    if id(n) in reported or not isinstance(n, ast.Call):
+                        continue
+                    msg = self._host_call_message(n, mod)
+                    if msg:
+                        reported.add(id(n))
+                        yield mod.finding(self.code, msg, n), n
+
+    # -- traced-function discovery -----------------------------------------
+    def _traced_functions(self, mod):
+        traced = []
+        # defs by name per enclosing scope, to resolve f in jax.jit(f)
+        defs_by_name = {}
+        for n in ast.walk(mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(n.name, []).append(n)
+        for n in ast.walk(mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in n.decorator_list:
+                    if _is_tracing_wrapper(dec, mod):
+                        traced.append(n)
+                        break
+            elif isinstance(n, ast.Call) and _is_tracing_wrapper(n, mod):
+                for arg in n.args:
+                    if isinstance(arg, ast.Lambda):
+                        traced.append(arg)
+                    elif isinstance(arg, ast.Name):
+                        for d in defs_by_name.get(arg.id, []):
+                            traced.append(d)
+        return traced
+
+    # -- host-call classification ------------------------------------------
+    def _host_call_message(self, call: ast.Call, mod):
+        resolved = mod.resolve(call.func)
+        if resolved in _HOST_CALLS:
+            return (f"`{resolved.replace('numpy', 'np')}` inside traced code "
+                    f"forces a device->host transfer (ConcretizationTypeError "
+                    f"under jit) — keep the value on device or move this out "
+                    f"of the traced body")
+        if resolved == "print":
+            return ("print inside traced code executes once at TRACE time "
+                    "with a tracer repr, never per step — use "
+                    "jax.debug.print or return the value")
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _HOST_METHODS):
+            return (f"`.{call.func.attr}()` inside traced code forces a "
+                    f"host sync — return the array and read it outside the "
+                    f"traced body")
+        if (isinstance(call.func, ast.Name) and call.func.id in _CASTS
+                and call.func.id not in mod.imports and len(call.args) == 1
+                and not isinstance(call.args[0], ast.Constant)
+                and not _static_shape_expr(call.args[0])):
+            return (f"`{call.func.id}()` on a traced value raises "
+                    f"ConcretizationTypeError under jit (and is a host sync "
+                    f"outside) — use jnp casts or shape-static arithmetic")
+        return None

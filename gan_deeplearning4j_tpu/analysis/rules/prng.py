@@ -1,0 +1,173 @@
+"""JG001 — PRNG key reuse.
+
+JAX random functions are pure: the same key yields the same stream, so a key
+passed to two ``jax.random.*`` draws without an intervening ``split`` /
+``fold_in`` silently correlates the draws. In a GAN that is not a crash, it
+is a *quality* bug — e.g. z_fake == z_gan would feed the discriminator and
+generator phases identical latents forever (the exact class round-2 VERDICT
+weak #5 flagged in the fused iteration before ``fold_in``-per-step landed).
+
+Two detections, both scope-local and name-based (no dataflow across calls):
+
+1. straight-line reuse — the same key *expression* (``key``, ``ks[2]``)
+   is the key argument of two consuming ``jax.random.*`` calls with no
+   rebinding of its base name in between;
+2. loop reuse — a consuming call inside a for/while loop whose key
+   expression references no name bound in the loop body: every iteration
+   replays the same stream (``fid.py``'s per-stage draw is clean precisely
+   because its key IS the loop target).
+
+Key-deriving calls (``split``, ``fold_in``, ``PRNGKey``, ...) are not
+consumers; subscripted keys are tracked by full expression text, so
+``ks[0]`` vs ``ks[1]`` are distinct.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+# jax.random functions that DERIVE keys rather than consuming entropy
+_NON_CONSUMERS = {
+    "split", "fold_in", "PRNGKey", "key", "wrap_key_data", "key_data",
+    "clone", "key_impl",
+}
+
+
+def _consumer_name(call: ast.Call, mod) -> str | None:
+    resolved = mod.resolve(call.func)
+    if not resolved or not resolved.startswith("jax.random."):
+        return None
+    fn = resolved.rsplit(".", 1)[1]
+    if fn in _NON_CONSUMERS:
+        return None
+    return fn
+
+
+def _key_arg(call: ast.Call) -> ast.AST | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+def _stmt_eval_roots(stmt: ast.stmt):
+    """The expressions THIS statement evaluates itself. Compound statements
+    contribute only their headers — their bodies are scanned by block
+    recursion, which owns branch/loop key-tracking semantics."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _consumers_in(roots, mod):
+    """(call, fn, key_arg) for consuming jax.random calls under ``roots``,
+    nested def/lambda bodies excluded."""
+    out = []
+    for node in _common.walk_excluding_defs(roots):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _consumer_name(node, mod)
+        if fn is None:
+            continue
+        key = _key_arg(node)
+        if key is not None:
+            out.append((node, fn, key))
+    out.sort(key=lambda t: (t[0].lineno, t[0].col_offset))
+    return out
+
+
+def _stmt_consumers(stmt: ast.stmt, mod):
+    return _consumers_in(_stmt_eval_roots(stmt), mod)
+
+
+class PrngKeyReuse:
+    code = "JG001"
+    name = "prng-key-reuse"
+    summary = ("same PRNG key passed to two jax.random draws without an "
+               "intervening split/fold_in")
+
+    def check(self, mod):
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if not body:
+                continue
+            yield from self._scan_block(body, {}, mod, scope)
+
+    # -- block scan ---------------------------------------------------------
+    def _scan_block(self, stmts, used, mod, scope):
+        """``used``: key-expression text -> (first consumer line, fn name)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes handled by iter_scopes
+            for call, fn, key in _stmt_consumers(stmt, mod):
+                expr = ast.unparse(key)
+                if expr in used:
+                    first_line, first_fn = used[expr]
+                    f = mod.finding(
+                        self.code,
+                        f"PRNG key `{expr}` already consumed by "
+                        f"jax.random.{first_fn} at line {first_line} — "
+                        f"split/fold_in before drawing again",
+                        call,
+                    )
+                    yield f, call
+                else:
+                    used[expr] = (call.lineno, fn)
+            # rebinding this statement's targets retires their keys
+            rebound = _common.assignment_targets(stmt)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                _common._target_names(stmt.target, rebound)
+            if rebound:
+                for expr in [e for e in used
+                             if _expr_base(e) in rebound]:
+                    del used[expr]
+            # recurse into compound statements
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                yield from self._scan_loop(stmt, dict(used), mod, scope)
+            elif isinstance(stmt, ast.If):
+                yield from self._scan_block(stmt.body, dict(used), mod, scope)
+                yield from self._scan_block(stmt.orelse, dict(used), mod, scope)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._scan_block(stmt.body, used, mod, scope)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._scan_block(block, used, mod, scope)
+                for handler in stmt.handlers:
+                    yield from self._scan_block(handler.body, dict(used),
+                                                mod, scope)
+
+    def _scan_loop(self, loop, used, mod, scope):
+        """Straight-line reuse inside the body, plus the loop-replay check:
+        a consumer whose key derives from nothing bound per-iteration."""
+        yield from self._scan_block(loop.body, used, mod, scope)
+        loop_bound = _common.bound_names(loop)
+        for call, fn, key in _consumers_in(loop.body, mod):
+            if not (_common.loaded_names(key) & loop_bound):
+                expr = ast.unparse(key)
+                f = mod.finding(
+                    self.code,
+                    f"PRNG key `{expr}` consumed by jax.random.{fn} "
+                    f"inside a loop but derived outside it — every "
+                    f"iteration replays the same stream; fold_in the "
+                    f"loop index",
+                    call,
+                )
+                yield f, call
+
+
+def _expr_base(expr_text: str) -> str:
+    for i, ch in enumerate(expr_text):
+        if not (ch.isalnum() or ch == "_"):
+            return expr_text[:i]
+    return expr_text

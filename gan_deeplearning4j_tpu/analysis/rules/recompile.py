@@ -1,0 +1,124 @@
+"""JG004 — recompilation hazards.
+
+``jax.jit`` caches compiled programs on the *callable object* plus static
+argument values. Two mechanical ways this repo could (and related repos do)
+defeat the cache:
+
+1. jit-in-loop — calling ``jax.jit(...)`` (or decorating a def) inside a
+   for/while body constructs a FRESH traced callable every iteration: every
+   call retraces and recompiles. On the tunneled axon platform one XLA
+   compile is seconds-to-minutes (bench.py measured 70-140 s scan compiles
+   on CPU), so this turns a training loop into a compile loop. The jitted
+   callable belongs outside the loop (this repo's ``_build_*`` idiom).
+
+2. unhashable static argument — passing a list/dict/set (or a comprehension)
+   at a ``static_argnums`` position raises ``TypeError: unhashable`` at
+   best; a fresh hashable object of unstable identity recompiles per call.
+   Statically visible container literals at known-static positions are
+   flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_JIT_NAMES = {"jax.jit", "jax.pmap"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _is_jit_call(node: ast.AST, mod) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = mod.resolve(node.func)
+    if resolved in _JIT_NAMES:
+        return True
+    # functools.partial(jax.jit, ...)
+    if resolved == "functools.partial" and node.args:
+        return mod.resolve(node.args[0]) in _JIT_NAMES
+    return False
+
+
+def _static_argnums(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            return _common.literal_int_tuple(kw.value)
+    return None
+
+
+class RecompilationHazard:
+    code = "JG004"
+    name = "recompilation-hazard"
+    summary = "jit constructed per-iteration or unhashable static argument"
+
+    def check(self, mod):
+        yield from self._check_jit_in_loop(mod)
+        yield from self._check_static_args(mod)
+
+    def _check_jit_in_loop(self, mod):
+        seen = set()
+        for loop in _common.iter_loops(mod.tree):
+            for n in ast.walk(loop):
+                if n is loop or id(n) in seen:
+                    continue
+                if _is_jit_call(n, mod):
+                    seen.add(id(n))
+                    f = mod.finding(
+                        self.code,
+                        "jax.jit called inside a loop — constructs a fresh "
+                        "traced callable (and a fresh compile) every "
+                        "iteration; build the jitted function once, outside",
+                        n,
+                    )
+                    yield f, n
+                elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in n.decorator_list:
+                        target = dec.func if isinstance(dec, ast.Call) else dec
+                        if (mod.resolve(target) in _JIT_NAMES
+                                or _is_jit_call(dec, mod)) \
+                                and id(n) not in seen:
+                            seen.add(id(n))
+                            f = mod.finding(
+                                self.code,
+                                f"function `{n.name}` is defined and jitted "
+                                f"inside a loop — every iteration compiles "
+                                f"a new program; hoist the definition out",
+                                n,
+                            )
+                            yield f, n
+
+    def _check_static_args(self, mod):
+        """Track ``name = jax.jit(f, static_argnums=...)`` per scope, then
+        flag container literals at static positions of ``name(...)`` calls."""
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if not body:
+                continue
+            static_by_name = {}
+            for stmt in body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and _is_jit_call(stmt.value, mod)):
+                    nums = _static_argnums(stmt.value)
+                    if nums:
+                        static_by_name[stmt.targets[0].id] = nums
+            if not static_by_name:
+                continue
+            for n in ast.walk(scope):
+                if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                        and n.func.id in static_by_name):
+                    for pos in static_by_name[n.func.id]:
+                        if pos < len(n.args) and isinstance(
+                                n.args[pos], _UNHASHABLE):
+                            f = mod.finding(
+                                self.code,
+                                f"unhashable {type(n.args[pos]).__name__} "
+                                f"literal at static_argnums position {pos} "
+                                f"of `{n.func.id}` — static args must be "
+                                f"hashable and stable, or every call "
+                                f"recompiles",
+                                n.args[pos],
+                            )
+                            yield f, n

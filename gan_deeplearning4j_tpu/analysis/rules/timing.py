@@ -1,0 +1,134 @@
+"""JG002 — stale-fence timing.
+
+On the tunneled axon platform a dispatch returns immediately and even
+``block_until_ready`` can return before execution finishes, so a timed loop
+must fence on a device->host read of a value produced by THE CALL BEING
+TIMED. Fencing on anything older measures dispatch latency, not execution:
+the round-5 ``scripts/mfu_ceiling.py`` harness timed every call against the
+*warmup* output and reported numbers whose error was unbounded.
+
+Two patterns:
+
+1. in-loop stale fence — a for/while loop that reads a wall clock
+   (``time.perf_counter`` etc.) AND contains a fence call
+   (``np.asarray(...)``, ``jax.block_until_ready``/``device_get``,
+   ``.block_until_ready()``, ``.item()``) none of whose argument names is
+   bound inside the loop: the fenced value cannot be this iteration's
+   output. (The bench's chunk loops are clean: the fence reads ``losses``,
+   rebound every iteration.)
+
+2. stale sync callback — a ZERO-argument lambda whose body fences a name
+   bound in the enclosing function, passed to a call alongside another
+   callable argument (the ``_timed_calls(fn, sync)`` shape). A sync
+   callback that takes no parameter can never see the timed call's fresh
+   output; the fix is ``sync(fn())`` with ``lambda out: np.asarray(...)``.
+   This is the exact ``mfu_ceiling.py:164`` bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_CLOCKS = {
+    "time.perf_counter", "time.perf_counter_ns", "time.monotonic",
+    "time.monotonic_ns", "time.time", "timeit.default_timer",
+}
+_FENCE_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "jax.block_until_ready", "jax.device_get",
+}
+_FENCE_METHODS = {"block_until_ready", "item"}
+
+
+def _fence_read_names(call: ast.Call, mod):
+    """Names whose values a fence call forces to host, or None if ``call``
+    is not a fence."""
+    resolved = mod.resolve(call.func)
+    if resolved in _FENCE_CALLS:
+        names = set()
+        for arg in call.args:
+            names |= _common.loaded_names(arg)
+        return names
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _FENCE_METHODS and not call.args):
+        return _common.loaded_names(call.func.value)
+    return None
+
+
+class StaleFenceTiming:
+    code = "JG002"
+    name = "stale-fence-timing"
+    summary = ("timed loop syncs on a value bound outside the loop — "
+               "measures dispatch, not execution")
+
+    def check(self, mod):
+        yield from self._check_loops(mod)
+        yield from self._check_sync_callbacks(mod)
+
+    # -- pattern 1: in-loop stale fence ------------------------------------
+    def _check_loops(self, mod):
+        for loop in _common.iter_loops(mod.tree):
+            has_clock = any(
+                isinstance(n, ast.Call) and mod.resolve(n.func) in _CLOCKS
+                for n in _common.walk_excluding_defs(loop)
+            )
+            if not has_clock:
+                continue
+            loop_bound = _common.bound_names(loop)
+            for n in _common.walk_excluding_defs(loop):
+                if not isinstance(n, ast.Call):
+                    continue
+                read = _fence_read_names(n, mod)
+                if read and not (read & loop_bound):
+                    f = mod.finding(
+                        self.code,
+                        f"timed loop fences on "
+                        f"`{ast.unparse(n)[:60]}` but none of "
+                        f"{sorted(read - {'next', 'iter'})} is assigned in "
+                        f"the loop — the fence waits on a stale value, not "
+                        f"this iteration's output",
+                        n,
+                    )
+                    yield f, n
+
+    # -- pattern 2: zero-arg stale sync callback ---------------------------
+    def _check_sync_callbacks(self, mod):
+        # enclosing-scope bindings, innermost function wins
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if body is None:
+                continue
+            scope_bound = _common.bound_names(scope)
+            for n in ast.walk(scope):
+                if not isinstance(n, ast.Call) or len(n.args) < 2:
+                    continue
+                lambdas = [a for a in n.args if isinstance(a, ast.Lambda)]
+                if len(lambdas) < 2 and not (
+                    lambdas and any(
+                        not isinstance(a, ast.Lambda)
+                        and isinstance(a, (ast.Name, ast.Attribute))
+                        for a in n.args
+                    )
+                ):
+                    continue
+                for lam in lambdas:
+                    if lam.args.args or lam.args.posonlyargs or lam.args.kwonlyargs:
+                        continue  # takes a parameter: can receive the output
+                    for inner in ast.walk(lam.body):
+                        if not isinstance(inner, ast.Call):
+                            continue
+                        read = _fence_read_names(inner, mod)
+                        if read and (read & scope_bound):
+                            f = mod.finding(
+                                self.code,
+                                f"zero-argument sync callback fences "
+                                f"`{ast.unparse(inner)[:60]}` from the "
+                                f"enclosing scope — it can never see the "
+                                f"timed call's own output; pass the result "
+                                f"through the callback's parameter",
+                                lam,
+                            )
+                            yield f, lam
+                            break

@@ -63,6 +63,15 @@ def shape_struct(tree):
     )
 
 
+def cost_analysis_dict(cost):
+    """``compiled.cost_analysis()`` compat: modern jax returns one dict,
+    0.4.x wheels a list of per-computation dicts (entry computation first).
+    Returns the entry dict, or None when the backend has no cost model."""
+    if isinstance(cost, (list, tuple)):
+        return cost[0] if cost else None
+    return cost
+
+
 def _one_opt_step(graph, opt, state: TrainState, feats, labels, key,
                   lr_scale=None):
     """One optimizer step on one minibatch — the traced core both fused-body
@@ -392,7 +401,10 @@ class GanExperiment:
         and fake minibatches rather than the phased path's worker-major
         regrouping. Both are documented DL4J-analog layouts; losses are
         cross-worker means either way."""
-        from jax import shard_map as _shard_map
+        try:
+            from jax import shard_map as _shard_map
+        except ImportError:  # pragma: no cover - older wheel: experimental
+            from jax.experimental.shard_map import shard_map as _shard_map
         from jax.sharding import PartitionSpec as P
 
         from gan_deeplearning4j_tpu.parallel.param_averaging import _average_tree
@@ -738,7 +750,9 @@ class GanExperiment:
             jax.ShapeDtypeStruct((b, 1), f32),
         )
         with compute_dtype_scope(self._compute_dtype):
-            cost = self._fused.lower(*args).compile().cost_analysis()
+            cost = cost_analysis_dict(
+                self._fused.lower(*args).compile().cost_analysis()
+            )
         if not cost or "flops" not in cost:
             return None
         return float(cost["flops"])

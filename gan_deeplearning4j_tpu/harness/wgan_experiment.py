@@ -25,6 +25,7 @@ import numpy as np
 from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
 from gan_deeplearning4j_tpu.harness.experiment import (
     GanExperiment,
+    cost_analysis_dict,
     latent_grid,
     shape_struct,
 )
@@ -188,14 +189,14 @@ class WganGpExperiment(GanExperiment):
         struct = shape_struct
         key = jax.ShapeDtypeStruct((2,), jnp.uint32)
         with compute_dtype_scope(self._compute_dtype):
-            critic = self.trainer._critic_round.lower(
+            critic = cost_analysis_dict(self.trainer._critic_round.lower(
                 struct(self.critic_state), struct(self.gen_state.params),
                 jax.ShapeDtypeStruct((n, b // n, mcfg.num_features), f32), key,
-            ).compile().cost_analysis()
-            gen = self.trainer._gen_step.lower(
+            ).compile().cost_analysis())
+            gen = cost_analysis_dict(self.trainer._gen_step.lower(
                 struct(self.gen_state), struct(self.critic_state.params),
                 jax.ShapeDtypeStruct((b // n, mcfg.z_size), f32),
-            ).compile().cost_analysis()
+            ).compile().cost_analysis())
         if not critic or "flops" not in critic or not gen or "flops" not in gen:
             return None
         return float(critic["flops"]) * n + float(gen["flops"])

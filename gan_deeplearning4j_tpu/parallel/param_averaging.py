@@ -27,11 +27,32 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-# requires modern jax (shard_map + lax.pcast at the top level)
-from jax import shard_map as _shard_map
+# modern jax exports shard_map at the top level; 0.4.x kept it under
+# jax.experimental — accept both so older-wheel CPU containers (CI) import
+# the same code path the TPU rig runs on the new wheel
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - depends on installed jax wheel
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 from gan_deeplearning4j_tpu.optim.optimizer import GraphOptimizer
 from gan_deeplearning4j_tpu.parallel.trainer import TrainState, make_train_state
+
+
+if hasattr(jax.lax, "pcast"):
+    def _to_varying(x, axis_name: str):
+        """Mark a replicated value as worker-varying for shard_map's
+        replication checker (a type-system cast — runtime no-op)."""
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+    _SHARD_MAP_COMPAT: Dict[str, Any] = {}
+else:  # pragma: no cover - older wheel (no pcast): can't annotate the
+    # replicated->varying carry transition, so disable the rep checker
+    # instead; the compiled math is identical either way
+    def _to_varying(x, axis_name: str):
+        return x
+
+    _SHARD_MAP_COMPAT = {"check_rep": False}
 
 
 def _average_tree(tree, axis_name: str):
@@ -117,7 +138,7 @@ class ParameterAveragingTrainer:
             # the replicated broadcast params become worker-varying once they
             # absorb sharded-data gradients; mark the carry as such up front
             carry0 = jax.tree_util.tree_map(
-                lambda x: jax.lax.pcast(x, axis, to="varying"),
+                lambda x: _to_varying(x, axis),
                 (state.params, state.opt_state),
             )
             (params, opt_state), losses = jax.lax.scan(body, carry0, (feats, labels, keys))
@@ -134,6 +155,7 @@ class ParameterAveragingTrainer:
             mesh=self.mesh,
             in_specs=(P(), P(axis), P(axis), P()),
             out_specs=(P(), P()),
+            **_SHARD_MAP_COMPAT,
         )
         return jax.jit(mapped, donate_argnums=(0,))
 
@@ -186,13 +208,13 @@ class ParameterAveragingTrainer:
                 # the averaged values are replicated in VALUE, but the outer
                 # scan needs a rep-type-stable carry — keep it varying
                 carry = jax.tree_util.tree_map(
-                    lambda x: jax.lax.pcast(x, axis, to="varying"),
+                    lambda x: _to_varying(x, axis),
                     (params, opt_state),
                 )
                 return carry, jax.lax.pmean(losses, axis)
 
             carry0 = jax.tree_util.tree_map(
-                lambda x: jax.lax.pcast(x, axis, to="varying"),
+                lambda x: _to_varying(x, axis),
                 (state.params, state.opt_state),
             )
             (params, opt_state), losses = jax.lax.scan(
@@ -212,6 +234,7 @@ class ParameterAveragingTrainer:
             mesh=self.mesh,
             in_specs=(P(), P(None, axis), P(None, axis), P()),
             out_specs=(P(), P()),
+            **_SHARD_MAP_COMPAT,
         )
         return jax.jit(mapped, donate_argnums=(0,))
 

@@ -41,13 +41,18 @@ SCAN_K = 128  # match the bench's device-loop window
 
 
 def _timed_calls(fn, sync, *, min_s=3.0, max_calls=50) -> float:
-    """Median seconds per call over enough calls to cover ``min_s``."""
-    fn(); sync()  # warmup/compile
+    """Median seconds per call over enough calls to cover ``min_s``.
+
+    ``sync`` receives EACH timed call's own return value and must fetch a
+    scalar derived from it — fencing on anything bound before the loop (the
+    pre-round-6 version synced a warmup output captured outside) measures
+    dispatch latency, not execution, with unbounded error on the tunneled
+    axon platform. jaxlint rule JG002 exists because of this function."""
+    sync(fn())  # warmup/compile
     times = []
     while sum(times) < min_s and len(times) < max_calls:
         t0 = time.perf_counter()
-        fn()
-        sync()
+        sync(fn())
         times.append(time.perf_counter() - t0)
     return float(np.median(times))
 
@@ -68,9 +73,8 @@ def bench_gemm(n: int, dtype, peak) -> dict:
         out, _ = jax.lax.scan(step, a, None, length=SCAN_K)
         return out
 
-    out = loop(a, b)
     sec_per_call = _timed_calls(
-        lambda: loop(a, b), lambda: np.asarray(out[0, 0]), min_s=2.0
+        lambda: loop(a, b), lambda out: np.asarray(out[0, 0]), min_s=2.0
     )
     # one n×n×n matmul = 2n³ FLOPs, K per call (tanh/scale are O(n²) noise)
     flops_per_call = 2.0 * n**3 * SCAN_K
@@ -151,12 +155,14 @@ def bench_bare(batch: int, peak) -> dict:
 
     args = (exp.dis_state.params, exp.gan_state.params,
             exp.cv_state.params, exp.gen_params)
-    cost = loop.lower(*args).compile().cost_analysis()
+    from gan_deeplearning4j_tpu.harness.experiment import cost_analysis_dict
+
+    cost = cost_analysis_dict(loop.lower(*args).compile().cost_analysis())
     flops_per_call = float(cost["flops"]) if cost and "flops" in cost else None
-    out = loop(*args)
-    leaf = jax.tree_util.tree_leaves(out)[0]
     sec_per_call = _timed_calls(
-        lambda: loop(*args), lambda: np.asarray(leaf).ravel()[:1], min_s=3.0
+        lambda: loop(*args),
+        lambda out: np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1],
+        min_s=3.0,
     )
     sec_per_iter = sec_per_call / SCAN_K
     mfu = None

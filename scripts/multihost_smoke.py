@@ -70,7 +70,14 @@ def main() -> int:
         num_processes=args.num_processes,
         process_id=args.process_id,
     )
-    assert jax.process_count() == args.num_processes, jax.process_count()
+    # explicit check, not a bare assert (stripped under -O — jaxlint JG003):
+    # a half-formed cluster must die loudly before any collective hangs
+    if jax.process_count() != args.num_processes:
+        raise SystemExit(
+            f"[multihost] expected {args.num_processes} processes, backend "
+            f"reports {jax.process_count()} — coordinator/process_id flags "
+            f"disagree with the cluster that actually formed"
+        )
     n_global = jax.device_count()
     n_local = jax.local_device_count()
     print(

@@ -30,7 +30,9 @@ def profile_once(compute_dtype, batch, iters, trace_dir):
     import jax.numpy as jnp
 
     from gan_deeplearning4j_tpu.harness import ExperimentConfig, make_experiment
-    from gan_deeplearning4j_tpu.harness.experiment import shape_struct
+    from gan_deeplearning4j_tpu.harness.experiment import (
+        cost_analysis_dict, shape_struct,
+    )
     from gan_deeplearning4j_tpu.runtime.dtype import compute_dtype_scope
     from gan_deeplearning4j_tpu.utils.profiling import device_trace
 
@@ -80,7 +82,8 @@ def profile_once(compute_dtype, batch, iters, trace_dir):
         jax.ShapeDtypeStruct((batch, 1), f32),
     )
     with compute_dtype_scope(exp._compute_dtype):
-        cost = exp._fused.lower(*args).compile().cost_analysis() or {}
+        cost = cost_analysis_dict(
+            exp._fused.lower(*args).compile().cost_analysis()) or {}
     flops = float(cost.get("flops", 0.0))
     bytes_accessed = float(cost.get("bytes accessed", 0.0))
     return {

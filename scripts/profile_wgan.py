@@ -53,6 +53,9 @@ def main() -> int:
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
+    from gan_deeplearning4j_tpu.harness.experiment import (
+        cost_analysis_dict, shape_struct,
+    )
     from gan_deeplearning4j_tpu.models.wgan_gp import WganGpConfig, WganGpTrainer
     from gan_deeplearning4j_tpu.ops import losses as loss_ops
     from gan_deeplearning4j_tpu.utils.profiling import device_trace
@@ -73,7 +76,8 @@ def main() -> int:
 
     def cost_of(fn, *fn_args):
         """(flops, bytes) of the compiled program for fn at these args."""
-        c = jax.jit(fn).lower(*fn_args).compile().cost_analysis() or {}
+        c = cost_analysis_dict(
+            jax.jit(fn).lower(*fn_args).compile().cost_analysis()) or {}
         return float(c.get("flops", 0.0)), float(c.get("bytes accessed", 0.0))
 
     def score(params, x):
@@ -105,21 +109,19 @@ def main() -> int:
     costs["w_term_grad"] = cost_of(jax.grad(w_loss), critic_state.params)
     costs["gp_term_grad"] = cost_of(jax.grad(gp_loss), critic_state.params)
     costs["full_loss_grad"] = cost_of(jax.grad(full_loss), critic_state.params)
-    from gan_deeplearning4j_tpu.harness.experiment import shape_struct
-
     costs["critic_round"] = tuple(
-        float((tr._critic_round.lower(
+        float((cost_analysis_dict(tr._critic_round.lower(
             shape_struct(critic_state), shape_struct(gen_state.params),
             jax.ShapeDtypeStruct((cfg.n_critic, b, f), jnp.float32),
             jax.ShapeDtypeStruct((2,), jnp.uint32),
-        ).compile().cost_analysis() or {}).get(k, 0.0))
+        ).compile().cost_analysis()) or {}).get(k, 0.0))
         for k in ("flops", "bytes accessed")
     )
     costs["gen_step"] = tuple(
-        float((tr._gen_step.lower(
+        float((cost_analysis_dict(tr._gen_step.lower(
             shape_struct(gen_state), shape_struct(critic_state.params),
             jax.ShapeDtypeStruct((b, cfg.z_size), jnp.float32),
-        ).compile().cost_analysis() or {}).get(k, 0.0))
+        ).compile().cost_analysis()) or {}).get(k, 0.0))
         for k in ("flops", "bytes accessed")
     )
 
