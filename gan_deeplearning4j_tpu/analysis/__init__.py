@@ -6,6 +6,15 @@ detectable at the AST level: a timing harness fencing on a stale output
 ``assert`` (stripped under ``python -O``), and PRNG/jit hygiene that only a
 human reviewer audited. This package turns those review rules into code.
 
+v2 is a TWO-PHASE analyzer. Phase 1 (:mod:`.project`) builds a project
+index — module/import graph, top-level symbol table, per-function summaries
+(donated parameters, PRNG-key parameters, traced-ness through decorator
+chains and ``functools.partial``, host-callback taint) — and phase 2 runs
+the rules with that index on every module, so donation misuse through
+``functools.partial``/import indirection, callbacks reached from timed
+regions, and axis arities of functions defined a module away are all
+visible (JG007–JG011 join PR 1's JG001–JG006).
+
 Deliberately jax-free and stdlib-only: the analyzer must run on the parent
 side of the bench architecture (bench.py's parent never imports jax — a dead
 chip can hang ``import jax`` for minutes) and in any CI container regardless
@@ -13,23 +22,30 @@ of which accelerator stack is installed.
 
 Public surface:
 
-- :func:`analyze_paths` / :func:`analyze_source` — run all rules, return
-  :class:`Report` (findings partitioned into active / suppressed /
-  baselined).
+- :func:`analyze_paths` / :func:`analyze_source` / :func:`analyze_sources`
+  — run all rules, return :class:`Report` (findings partitioned into
+  active / suppressed / baselined; ``analyze_sources`` analyzes several
+  in-memory modules under ONE project index — the cross-module fixture
+  entry point).
 - :class:`Finding` — one diagnostic, with a content-based fingerprint that
   is stable across line-number drift (rule code + path + normalized source
   line), so baselines survive unrelated edits.
-- :data:`RULES` — the rule registry (JG001-JG006; see
+- :data:`RULES` — the rule registry (JG001-JG011; see
   ``docs/STATIC_ANALYSIS.md`` for the catalogue and the real bug behind
   each rule).
 - CLI: ``python -m gan_deeplearning4j_tpu.analysis <paths>`` — exit 0 iff
   the tree is clean modulo the checked-in baseline
-  (``analysis/_baseline.json``). A tier-1 test
-  (``tests/test_analysis.py::test_tree_is_clean``) holds that invariant.
+  (``analysis/_baseline.json``). ``--format sarif`` for CI annotators,
+  ``--changed-only`` for the pre-commit fast path
+  (``scripts/lint_gate.sh``), ``--fix``/``--fix-suppress`` for the
+  mechanical-rewrite subset, ``--prune-baseline`` for baseline hygiene.
+  A tier-1 test (``tests/test_analysis.py::TestTreeIsClean``) holds the
+  clean-tree invariant, including over the analyzer's own package.
 
 Suppression: a trailing ``# jaxlint: disable=JG001`` (comma-separated codes,
 or ``all``) on any line of the offending statement suppresses the finding;
-suppressions are counted and reported, never silent.
+suppressions are counted and reported, never silent, and a suppression
+naming an unknown rule code is a reported warning, not a silent no-op.
 """
 
 from gan_deeplearning4j_tpu.analysis.engine import (
@@ -38,7 +54,10 @@ from gan_deeplearning4j_tpu.analysis.engine import (
     Report,
     analyze_paths,
     analyze_source,
+    analyze_sources,
+    changed_files,
     load_baseline,
+    prune_baseline,
 )
 from gan_deeplearning4j_tpu.analysis.rules import RULES
 
@@ -49,5 +68,8 @@ __all__ = [
     "RULES",
     "analyze_paths",
     "analyze_source",
+    "analyze_sources",
+    "changed_files",
     "load_baseline",
+    "prune_baseline",
 ]

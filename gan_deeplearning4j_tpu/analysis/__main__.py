@@ -4,13 +4,26 @@
 
 Exit codes: 0 clean (modulo baseline + suppressions), 1 active findings or
 stale baseline entries, 2 usage error. ``--format json`` emits one machine-
-readable object; default text output is one ``path:line:col: CODE message``
-row per finding — the same shape compiler diagnostics use, so editors and CI
-annotate it for free.
+readable object and ``--format sarif`` emits SARIF 2.1.0 for CI annotators;
+default text output is one ``path:line:col: CODE message`` row per finding —
+the same shape compiler diagnostics use, so editors annotate it for free.
 
-``--write-baseline`` snapshots the CURRENT active findings into the baseline
-file with a placeholder justification that the loader will refuse until a
-human edits it — regenerating a baseline is deliberately a two-step act.
+Modes beyond plain analysis:
+
+- ``--changed-only [--diff-base REF]`` restricts the run to .py files
+  changed vs the merge base with REF (plus untracked files) — the fast
+  pre-commit shape ``scripts/lint_gate.sh`` wraps;
+- ``--fix`` applies the mechanical rewrites (JG003 asserts, JG007
+  discarded updates) and re-reports what remains; ``--fix-suppress``
+  instead inserts per-line suppressions for every remaining active
+  finding and REQUIRES ``--justification``;
+- ``--prune-baseline`` rewrites the baseline file dropping entries whose
+  fingerprint no longer matches any finding (stale entries otherwise FAIL
+  the gate — a fixed bug must leave the baseline, not haunt it);
+- ``--write-baseline`` snapshots the CURRENT active findings into the
+  baseline file with a placeholder justification that the loader will
+  refuse until a human edits it — regenerating a baseline is deliberately
+  a two-step act.
 """
 
 from __future__ import annotations
@@ -23,6 +36,17 @@ from gan_deeplearning4j_tpu.analysis import engine
 from gan_deeplearning4j_tpu.analysis.rules import RULES
 
 
+def _emit(report, fmt: str, rules, baseline) -> None:
+    if fmt == "json":
+        print(json.dumps(report.to_json(), indent=2))
+    elif fmt == "sarif":
+        from gan_deeplearning4j_tpu.analysis import sarif
+
+        print(json.dumps(sarif.to_sarif(report, rules, baseline), indent=2))
+    else:
+        print(report.render_text())
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m gan_deeplearning4j_tpu.analysis",
@@ -30,7 +54,8 @@ def main(argv=None) -> int:
     )
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to analyze")
-    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--format", choices=["text", "json", "sarif"],
+                   default="text")
     p.add_argument("--baseline", default=engine.DEFAULT_BASELINE_PATH,
                    help="baseline file (default: the checked-in "
                         "analysis/_baseline.json)")
@@ -39,9 +64,26 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="snapshot current active findings into --baseline "
                         "with TODO justifications (edit before committing)")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite --baseline without entries whose "
+                        "fingerprint matches no current finding")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--changed-only", action="store_true",
+                   help="only analyze .py files changed vs --diff-base "
+                        "(merge base) plus untracked files")
+    p.add_argument("--diff-base", default="HEAD",
+                   help="git ref --changed-only diffs against via the merge "
+                        "base (default: HEAD = uncommitted changes only)")
+    p.add_argument("--fix", action="store_true",
+                   help="apply mechanical rewrites for fixable findings "
+                        "(JG003, JG007), then re-report")
+    p.add_argument("--fix-suppress", action="store_true",
+                   help="insert justified per-line suppressions for every "
+                        "remaining active finding (requires --justification)")
+    p.add_argument("--justification", default=None,
+                   help="human reason recorded by --fix-suppress")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -50,6 +92,9 @@ def main(argv=None) -> int:
         return 0
     if not args.paths:
         p.error("no paths given")
+    if args.fix_suppress and not (args.justification or "").strip():
+        p.error("--fix-suppress requires --justification (a suppression "
+                "must say why)")
 
     rules = RULES
     if args.rules:
@@ -66,10 +111,28 @@ def main(argv=None) -> int:
         return 2
 
     try:
-        report = engine.analyze_paths(args.paths, rules=rules, baseline=baseline)
+        targets = engine.collect_files(args.paths)
     except FileNotFoundError as exc:
         print(f"jaxlint: {exc}", file=sys.stderr)
         return 2
+
+    if args.changed_only:
+        try:
+            changed = set(engine.changed_files(base=args.diff_base))
+        except RuntimeError as exc:
+            print(f"jaxlint: --changed-only needs a usable git checkout: "
+                  f"{exc}", file=sys.stderr)
+            return 2
+        targets = [t for t in targets if t in changed]
+        if not targets:
+            print("# jaxlint: no changed .py files under the given paths",
+                  file=sys.stderr)
+            return 0
+
+    def run():
+        return engine.analyze_paths(targets, rules=rules, baseline=baseline)
+
+    report = run()
 
     if args.write_baseline:
         entries = [
@@ -83,19 +146,39 @@ def main(argv=None) -> int:
             }
             for f in report.active
         ]
-        with open(args.baseline, "w") as fh:
-            json.dump({"entries": entries}, fh, indent=2)
-            fh.write("\n")
+        engine.write_baseline(entries, args.baseline)
         print(f"jaxlint: wrote {len(entries)} entries to {args.baseline} — "
               f"replace every TODO justification before committing",
               file=sys.stderr)
         return 0
 
-    if args.format == "json":
-        print(json.dumps(report.to_json(), indent=2))
-    else:
-        print(report.render_text())
-    return 0 if report.clean and not report.stale_baseline else 1
+    if args.prune_baseline:
+        removed = engine.prune_baseline(report, baseline or [], args.baseline)
+        print(f"jaxlint: pruned {removed} stale baseline "
+              f"entr{'y' if removed == 1 else 'ies'} from {args.baseline}",
+              file=sys.stderr)
+        baseline = engine.load_baseline(args.baseline)
+        report = run()
+
+    if args.fix or args.fix_suppress:
+        from gan_deeplearning4j_tpu.analysis import fix as _fix
+
+        result = _fix.apply_fixes(
+            report,
+            suppress=args.fix_suppress,
+            justification=args.justification,
+        )
+        print(
+            f"jaxlint: rewrote {result.rewritten}, suppressed "
+            f"{result.suppressed} finding(s) in {len(result.files)} file(s)",
+            file=sys.stderr,
+        )
+        for s in result.skipped:
+            print(f"jaxlint: not mechanically fixable: {s}", file=sys.stderr)
+        report = run()  # re-analyze: the output reflects the tree on disk
+
+    _emit(report, args.format, rules, baseline)
+    return 0 if report.gate_ok else 1
 
 
 if __name__ == "__main__":

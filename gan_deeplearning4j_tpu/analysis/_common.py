@@ -12,6 +12,36 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional
 
+# -- shared name sets (single source of truth for every rule) ---------------
+
+#: callables whose function argument is traced (jit/grad/vmap/shard_map and
+#: the lax control-flow combinators)
+TRACING_WRAPPERS = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian", "jax.checkpoint", "jax.remat",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.custom_jvp", "jax.custom_vjp",
+}
+
+#: wall-clock reads that mark a region as "timed"
+CLOCK_CALLS = {
+    "time.perf_counter", "time.perf_counter_ns", "time.monotonic",
+    "time.monotonic_ns", "time.time", "timeit.default_timer",
+}
+
+#: host-callback escapes out of traced code — each one serializes the device
+#: pipeline through the host when it runs
+HOST_CALLBACKS = {
+    "jax.pure_callback", "jax.experimental.pure_callback",
+    "jax.experimental.io_callback", "jax.experimental.io_callback.io_callback",
+    "jax.debug.callback", "jax.debug.print",
+}
+
+#: jit-like transforms that accept donate_argnums
+JIT_WRAPPERS = {"jax.jit", "jax.pmap"}
+
 
 def build_import_map(tree: ast.AST) -> dict:
     """Local name -> fully-qualified dotted prefix, from import statements.

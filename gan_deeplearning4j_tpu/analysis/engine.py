@@ -70,6 +70,7 @@ class SourceModule:
     suppressions: dict  # line number -> set of codes (or {"all"})
     imports: dict  # local name -> dotted prefix (see _common.build_import_map)
     is_test: bool
+    project: Optional[object] = None  # ProjectIndex, set by analyze_modules
 
     def resolve(self, node: ast.AST) -> Optional[str]:
         return _common.resolve_name(node, self.imports)
@@ -105,26 +106,37 @@ class SourceModule:
 
 @dataclasses.dataclass
 class Report:
-    """Partitioned analysis result. ``active`` is what gates CI."""
+    """Partitioned analysis result. ``active`` is what gates CI; so do stale
+    baseline entries (:attr:`gate_ok`) — a baseline that matches nothing is
+    a fixed bug still being excused, and carrying it silently would let the
+    next occurrence of the same fingerprint slip through."""
 
     active: List[Finding]
     suppressed: List[Finding]
     baselined: List[Finding]
     stale_baseline: List[dict]  # baseline entries that matched nothing
     files: int
+    warnings: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def clean(self) -> bool:
         return not self.active
 
+    @property
+    def gate_ok(self) -> bool:
+        """What CI keys on: no active findings AND no stale baseline."""
+        return self.clean and not self.stale_baseline
+
     def to_json(self) -> dict:
         return {
             "clean": self.clean,
+            "gate_ok": self.gate_ok,
             "files": self.files,
             "active": [f.to_json() for f in self.active],
             "suppressed": [f.to_json() for f in self.suppressed],
             "baselined": [f.to_json() for f in self.baselined],
             "stale_baseline": self.stale_baseline,
+            "warnings": self.warnings,
         }
 
     def render_text(self) -> str:
@@ -133,8 +145,11 @@ class Report:
             out.append(
                 f"# stale baseline entry {entry.get('fingerprint')} "
                 f"({entry.get('rule')} {entry.get('path')}) — offending line "
-                f"changed or was fixed; remove it from the baseline"
+                f"changed or was fixed; remove it from the baseline "
+                f"(or run --prune-baseline)"
             )
+        for w in self.warnings:
+            out.append(f"# warning: {w}")
         out.append(
             f"# jaxlint: {self.files} files, {len(self.active)} active, "
             f"{len(self.suppressed)} suppressed, "
@@ -258,6 +273,71 @@ def load_baseline(path: Optional[str] = None) -> List[dict]:
     return entries
 
 
+def write_baseline(entries: List[dict], path: Optional[str] = None) -> None:
+    path = path or DEFAULT_BASELINE_PATH
+    with open(path, "w") as fh:
+        json.dump({"entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def prune_baseline(report: "Report", baseline: List[dict],
+                   path: Optional[str] = None) -> int:
+    """Drop the baseline entries ``report`` found stale (their fingerprint
+    matched no finding) and rewrite the baseline file. Returns the number of
+    entries removed. The surviving entries keep their order and their
+    human-written justifications untouched."""
+    stale_fps = {e.get("fingerprint") for e in report.stale_baseline}
+    if not stale_fps:
+        return 0
+    kept = [e for e in baseline if e.get("fingerprint") not in stale_fps]
+    write_baseline(kept, path)
+    return len(baseline) - len(kept)
+
+
+def changed_files(root: Optional[str] = None, base: str = "HEAD") -> List[str]:
+    """Python files changed relative to ``base`` (``git diff`` against the
+    merge base) plus untracked ones — the ``--changed-only`` working set.
+    Raises RuntimeError when git is unusable: a pre-commit gate that cannot
+    see the diff must fail loudly, not pass on an empty file list."""
+    import subprocess
+
+    root = os.path.abspath(root or os.getcwd())
+
+    def git(*args: str) -> str:
+        proc = subprocess.run(
+            ["git", "-C", root, *args],
+            capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: {proc.stderr.strip()}"
+            )
+        return proc.stdout
+
+    merge_base = git("merge-base", "HEAD", base).strip() if base != "HEAD" \
+        else "HEAD"
+    # `git diff --name-only` emits repo-TOPLEVEL-relative paths while
+    # `ls-files --others` emits cwd-relative ones — normalize both against
+    # the toplevel, then re-relativize to ``root`` so a run from a repo
+    # subdirectory still sees every changed tracked file (a silent drop
+    # here is exactly the empty-file-list pass this function must prevent)
+    top = git("rev-parse", "--show-toplevel").strip()
+    out = git("diff", "--name-only", "-z", merge_base, "--")
+    out += git("ls-files", "--others", "--exclude-standard", "--full-name",
+               "-z")
+    files = set()
+    for f in out.split("\0"):
+        if not f.endswith(".py"):
+            continue
+        ap = os.path.join(top, f)
+        if not os.path.isfile(ap):
+            continue
+        rp = os.path.relpath(ap, root)
+        if not rp.startswith(".."):
+            files.add(rp)
+    return sorted(files)
+
+
 def _run_rules(mod: SourceModule, rules) -> List[tuple]:
     """[(finding, node)] for one module, rule errors converted to findings
     (an analyzer crash must be visible, not a silent pass)."""
@@ -290,14 +370,36 @@ def _run_rules(mod: SourceModule, rules) -> List[tuple]:
 
 
 def analyze_modules(mods, rules=None, baseline=None) -> Report:
-    from gan_deeplearning4j_tpu.analysis.rules import RULES
+    """Two-phase analysis: materialize every module, build the project
+    index (phase 1), then run the rules (phase 2). Cross-module rules may
+    attribute a finding to a DIFFERENT file than the one being iterated
+    (e.g. a scan body defined a module away) — suppression is therefore
+    checked against the module that owns the finding's path."""
+    from gan_deeplearning4j_tpu.analysis import project as _project
+    from gan_deeplearning4j_tpu.analysis.rules import RULES, RULES_BY_CODE
 
     rules = RULES if rules is None else rules
     baseline = baseline or []
     by_fp = {e["fingerprint"]: e for e in baseline}
     matched_fps = set()
     active, suppressed, baselined = [], [], []
+    warnings: List[str] = []
     seen = set()  # scope overlap can surface one defect twice — keep first
+    mods = list(mods)
+    parsed = [m for m in mods if isinstance(m, SourceModule)]
+    index = _project.build_index(parsed)
+    mod_by_path = {}
+    for m in parsed:
+        m.project = index
+        mod_by_path[m.path] = m
+    known_codes = set(RULES_BY_CODE) | {"all", "JG000"}
+    for m in parsed:
+        for line, codes in sorted(m.suppressions.items()):
+            for code in sorted(codes - known_codes):
+                warnings.append(
+                    f"{m.path}:{line}: suppression names unknown rule code "
+                    f"{code!r} — it suppresses nothing; check for a typo"
+                )
     files = 0
     for mod in mods:
         files += 1
@@ -309,16 +411,31 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
             if key in seen:
                 continue
             seen.add(key)
-            if mod.suppressed(finding, node):
+            owner = mod_by_path.get(finding.path, mod)
+            if owner.suppressed(finding, node):
                 suppressed.append(finding)
             elif finding.fingerprint in by_fp:
                 matched_fps.add(finding.fingerprint)
                 baselined.append(finding)
             else:
                 active.append(finding)
-    stale = [e for e in baseline if e["fingerprint"] not in matched_fps]
+    # Staleness is judged ONLY within this run's scope: an entry whose path
+    # was not analyzed or whose rule did not run might still match on the
+    # next full run — calling it stale here would fail every scoped run
+    # (--changed-only, path subsets, --rules) and let --prune-baseline
+    # delete still-valid entries. Entries without path/rule metadata are
+    # conservatively treated as in-scope.
+    analyzed = {m.path for m in mods if hasattr(m, "path")}
+    rule_codes = {r.code for r in rules}
+    stale = [
+        e for e in baseline
+        if e["fingerprint"] not in matched_fps
+        and (not e.get("path") or e["path"] in analyzed)
+        and (not e.get("rule") or e["rule"] in rule_codes)
+    ]
     active.sort(key=lambda f: (f.path, f.line, f.code))
-    return Report(active, suppressed, baselined, stale, files)
+    return Report(active, suppressed, baselined, stale, files,
+                  warnings=warnings)
 
 
 def analyze_paths(paths, rules=None, baseline=None, root=None) -> Report:
@@ -346,3 +463,13 @@ def analyze_source(text: str, path: str = "<string>", rules=None,
     ``is_test=None`` derives test-ness from ``path`` like the file walker."""
     mod = parse_module(text, path, is_test=is_test)
     return analyze_modules([mod], rules=rules, baseline=baseline)
+
+
+def analyze_sources(sources: dict, rules=None, baseline=None) -> Report:
+    """Analyze several in-memory modules TOGETHER (one project index) —
+    the fixture entry point for cross-module rules. ``sources`` maps
+    engine-relative paths to module text; paths determine module names
+    (``pkg/mod.py`` -> ``pkg.mod``), so imports between the sources
+    resolve exactly as they would on disk."""
+    mods = [parse_module(text, path) for path, text in sorted(sources.items())]
+    return analyze_modules(mods, rules=rules, baseline=baseline)

@@ -1,0 +1,418 @@
+"""jaxlint phase 1 — the project index.
+
+PR 1's rules were deliberately scope-local; the hazards the ROADMAP queued
+next (donation through ``functools.partial``/helper indirection, host
+callbacks reached from timed regions, axis arities of functions defined a
+module away) are whole-program properties. This module builds the picture a
+single-file pass cannot see:
+
+- a **module graph**: every analyzed file gets a dotted module name derived
+  from its path, and its import map is absolutized against that name (so
+  ``from .trainer import make_train_state`` inside
+  ``gan_deeplearning4j_tpu.parallel`` resolves to
+  ``gan_deeplearning4j_tpu.parallel.trainer.make_train_state``);
+- a **symbol table** of top-level functions/classes/methods per module;
+- a :class:`FunctionSummary` per function: positional parameters, which of
+  them look like PRNG keys, whether the function is jit/shard_map-traced
+  (directly, via decorator chains, or through ``functools.partial``), which
+  ``donate_argnums`` it declares or returns from a builder, which resolved
+  callables it calls, and whether it performs a host callback
+  (``io_callback``/``pure_callback``/``jax.debug.*``) — with a transitive
+  ("tainted") closure over the intra-project call graph;
+- **module-level donators**: names bound at module scope to donating jitted
+  callables, including ``name = make_step()`` where ``make_step`` is a
+  builder imported from another module.
+
+Phase 2 (the rules) receives the index as ``mod.project`` on every
+:class:`~.engine.SourceModule`. Everything here is stdlib-only and purely
+syntactic — the index records what is *statically visible*, and rules are
+expected to treat absence of a summary as "unknown", never as "safe".
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_PRNG_PARAM_RE = re.compile(
+    r"^(key|keys|rng|rngs|prng|prng_key|subkey|sub_key|seed_key)$"
+)
+_PRNG_SUFFIXES = ("_key", "_keys", "_rng", "_rngs")
+
+
+def module_name_for_path(relpath: str) -> str:
+    """Dotted module name for an engine-relative path:
+    ``gan_deeplearning4j_tpu/harness/config.py`` ->
+    ``gan_deeplearning4j_tpu.harness.config``; a package ``__init__.py``
+    names the package itself; ``bench.py`` -> ``bench``."""
+    norm = relpath.replace(os.sep, "/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    parts = [p for p in norm.split("/") if p not in ("", ".")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def looks_like_prng_param(name: str) -> bool:
+    low = name.lower()
+    return bool(_PRNG_PARAM_RE.match(low)) or low.endswith(_PRNG_SUFFIXES)
+
+
+def jit_donate_argnums(call: ast.Call, scope_body, resolve) -> Optional[tuple]:
+    """``donate_argnums`` of a ``jax.jit``/``jax.pmap`` call, resolving both
+    the literal kwarg and the ``**kwargs``-dict-literal builder idiom this
+    repo uses (``kwargs = {"donate_argnums": (0,)} ... jax.jit(f, **kwargs)``
+    — the dict may gain sharding entries after the donate entry)."""
+    if not (isinstance(call, ast.Call) and resolve(call.func) in _common.JIT_WRAPPERS):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _common.literal_int_tuple(kw.value)
+        if kw.arg is None and isinstance(kw.value, ast.Name) and scope_body:
+            for stmt in scope_body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == kw.value.id
+                        and isinstance(stmt.value, ast.Dict)):
+                    for k, v in zip(stmt.value.keys, stmt.value.values):
+                        if (isinstance(k, ast.Constant)
+                                and k.value == "donate_argnums"):
+                            return _common.literal_int_tuple(v)
+    return None
+
+
+def _decorator_tracing_info(dec: ast.AST, resolve) -> Tuple[bool, Optional[tuple]]:
+    """(is_traced, donate_argnums) for one decorator, seeing through
+    ``@jax.jit``, ``@jax.jit(donate_argnums=...)`` and
+    ``@functools.partial(jax.jit, donate_argnums=...)``."""
+    if resolve(dec) in _common.TRACING_WRAPPERS:
+        return True, None
+    if isinstance(dec, ast.Call):
+        r = resolve(dec.func)
+        if r in _common.TRACING_WRAPPERS:
+            nums = None
+            if r in _common.JIT_WRAPPERS:
+                for kw in dec.keywords:
+                    if kw.arg == "donate_argnums":
+                        nums = _common.literal_int_tuple(kw.value)
+            return True, nums
+        if r == "functools.partial" and dec.args:
+            inner = resolve(dec.args[0])
+            if inner in _common.TRACING_WRAPPERS:
+                nums = None
+                if inner in _common.JIT_WRAPPERS:
+                    for kw in dec.keywords:
+                        if kw.arg == "donate_argnums":
+                            nums = _common.literal_int_tuple(kw.value)
+                return True, nums
+    return False, None
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    """What phase 2 may assume about one function without re-reading it."""
+
+    module: str
+    qualname: str          # "train_step" or "Trainer.fit_round"
+    name: str
+    lineno: int
+    params: Tuple[str, ...]          # positional params, self/cls stripped
+    num_defaults: int
+    is_method: bool
+    traced: bool                     # jit/shard_map/... via decorator chain
+    donates: Tuple[int, ...]         # donate_argnums from its own decorators
+    returns_donation: Tuple[int, ...]  # builder: returns jax.jit(..., donate)
+    prng_params: Tuple[str, ...]
+    calls: Tuple[str, ...]           # resolved names this function calls
+    has_host_callback: bool          # DIRECT io/pure_callback or jax.debug.*
+    node: ast.AST = dataclasses.field(repr=False, default=None)
+
+    @property
+    def min_arity(self) -> int:
+        return len(self.params) - self.num_defaults
+
+    @property
+    def fq(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """Per-module slice of the index."""
+
+    name: str
+    path: str
+    srcmod: object = dataclasses.field(repr=False, default=None)
+    is_package: bool = False
+    functions: Dict[str, FunctionSummary] = dataclasses.field(default_factory=dict)
+    donators: Dict[str, Tuple[int, ...]] = dataclasses.field(default_factory=dict)
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)  # absolutized
+
+    @property
+    def package(self) -> str:
+        """The package context relative imports resolve against."""
+        if self.is_package:
+            return self.name
+        return self.name.rpartition(".")[0]
+
+
+class ProjectIndex:
+    """The cross-module picture, built once per analysis run (phase 1)."""
+
+    def __init__(self, srcmods) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self._taint_cache: Dict[str, bool] = {}
+        for mod in srcmods:
+            self._index_module(mod)
+        # second pass: module-level donators that need every summary in place
+        for info in self.modules.values():
+            self._collect_donators(info)
+
+    # -- construction -------------------------------------------------------
+    def _index_module(self, mod) -> None:
+        name = module_name_for_path(mod.path)
+        info = ModuleInfo(
+            name=name,
+            path=mod.path,
+            srcmod=mod,
+            is_package=os.path.basename(mod.path) == "__init__.py",
+        )
+        info.imports = {
+            local: self._absolutize(info, dotted)
+            for local, dotted in mod.imports.items()
+        }
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._summarize(info, mod, node, qualprefix="", is_method=False)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._summarize(info, mod, sub,
+                                        qualprefix=node.name + ".",
+                                        is_method=True)
+        self.modules[name] = info
+        self.by_path[mod.path] = info
+
+    @staticmethod
+    def _absolutize(info: ModuleInfo, dotted: str) -> str:
+        """Resolve the import map's ``.``-prefixed relative targets against
+        the importing module's package."""
+        if not dotted.startswith("."):
+            return dotted
+        level = len(dotted) - len(dotted.lstrip("."))
+        rest = dotted[level:]
+        base_parts = info.package.split(".") if info.package else []
+        # level 1 = the containing package; each extra dot climbs one
+        base_parts = base_parts[: len(base_parts) - (level - 1)] if level > 1 else base_parts
+        base = ".".join(p for p in base_parts if p)
+        return f"{base}.{rest}" if base and rest else (base or rest)
+
+    def _summarize(self, info: ModuleInfo, mod, fn, qualprefix: str,
+                   is_method: bool) -> None:
+        a = fn.args
+        params = [p.arg for p in (a.posonlyargs + a.args)]
+        if is_method and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        traced, donates = False, None
+        for dec in fn.decorator_list:
+            t, d = _decorator_tracing_info(dec, mod.resolve)
+            traced = traced or t
+            donates = donates if d is None else d
+        returns_donation: Optional[tuple] = None
+        for ret in ast.walk(fn):
+            if isinstance(ret, ast.Return) and ret.value is not None:
+                nums = jit_donate_argnums(ret.value, fn.body, mod.resolve)
+                if nums:
+                    returns_donation = nums
+        calls: List[str] = []
+        has_cb = False
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            resolved = mod.resolve(n.func)
+            if resolved in _common.HOST_CALLBACKS:
+                has_cb = True
+            if resolved is None:
+                continue
+            calls.append(self._canonical_call(info, resolved))
+        summary = FunctionSummary(
+            module=info.name,
+            qualname=qualprefix + fn.name,
+            name=fn.name,
+            lineno=fn.lineno,
+            params=tuple(params),
+            num_defaults=len(a.defaults),
+            is_method=is_method,
+            traced=traced,
+            donates=tuple(donates or ()),
+            returns_donation=tuple(returns_donation or ()),
+            prng_params=tuple(p for p in params if looks_like_prng_param(p)),
+            calls=tuple(dict.fromkeys(calls)),
+            has_host_callback=has_cb,
+            node=fn,
+        )
+        info.functions[summary.qualname] = summary
+
+    def _canonical_call(self, info: ModuleInfo, resolved: str) -> str:
+        """Normalize a resolved call target into an index-wide name:
+        relative-import targets are absolutized against the module's
+        package, imported names become absolute module paths, bare local
+        names become ``<module>.<name>``; ``self.m`` attribute calls keep
+        their surface form and are matched per-module later."""
+        if resolved.startswith("."):
+            # the import map's '.'-prefixed pseudo-root (from .steps import
+            # step) — without this hop the name never matches the index
+            return self._absolutize(info, resolved)
+        first, _, rest = resolved.partition(".")
+        if first == "self":
+            return f"{info.name}.self.{rest}" if rest else resolved
+        mapped = info.imports.get(first)
+        if mapped is not None:
+            return f"{mapped}.{rest}" if rest else mapped
+        if "." not in resolved:
+            return f"{info.name}.{resolved}"
+        return resolved
+
+    def _collect_donators(self, info: ModuleInfo) -> None:
+        mod = info.srcmod
+        for stmt in mod.tree.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            target = stmt.targets[0].id
+            nums = jit_donate_argnums(stmt.value, mod.tree.body, mod.resolve)
+            if nums:
+                info.donators[target] = nums
+                continue
+            # name = builder() where builder is a (possibly imported)
+            # function that returns a donating jit
+            if isinstance(stmt.value, ast.Call) and not stmt.value.args:
+                summary = self.resolve_function(mod, stmt.value.func)
+                if summary is not None and summary.returns_donation:
+                    info.donators[target] = summary.returns_donation
+
+    # -- lookups ------------------------------------------------------------
+    def lookup(self, fq: str) -> Optional[FunctionSummary]:
+        """Find a summary by canonical name (``pkg.mod.fn`` or
+        ``pkg.mod.Class.method``) by longest-module-prefix match."""
+        parts = fq.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            module = ".".join(parts[:cut])
+            info = self.modules.get(module)
+            if info is not None:
+                qual = ".".join(parts[cut:])
+                return info.functions.get(qual)
+        return None
+
+    def resolve_function(self, mod, node_or_name) -> Optional[FunctionSummary]:
+        """Summary for a Name/Attribute expression in ``mod``'s namespace —
+        local functions, imported functions, one re-export hop through a
+        package ``__init__``."""
+        info = self.by_path.get(mod.path)
+        if info is None:
+            return None
+        if isinstance(node_or_name, str):
+            dotted = node_or_name
+        else:
+            dotted = _common.dotted_name(node_or_name)
+        if dotted is None:
+            return None
+        canonical = self._canonical_call(info, self._local_resolve(mod, dotted))
+        found = self.lookup(canonical)
+        if found is not None:
+            return found
+        # one re-export hop: pkg.__init__ imported the symbol from a submodule
+        parts = canonical.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            pkg = self.modules.get(".".join(parts[:cut]))
+            if pkg is not None and pkg.is_package:
+                tail = ".".join(parts[cut:])
+                head = tail.split(".")[0]
+                re_target = pkg.imports.get(head)
+                if re_target:
+                    rest = tail[len(head) + 1:]
+                    return self.lookup(
+                        f"{re_target}.{rest}" if rest else re_target)
+        return None
+
+    @staticmethod
+    def _local_resolve(mod, dotted: str) -> str:
+        first, _, rest = dotted.partition(".")
+        root = mod.imports.get(first)
+        if root is None:
+            return dotted
+        return f"{root}.{rest}" if rest else root
+
+    def imported_donator(self, mod, local_name: str) -> Optional[Tuple[int, ...]]:
+        """donate_argnums for ``local_name`` in ``mod`` when it is a
+        module-level donating callable imported from ANOTHER indexed module
+        (``from pkg.mod import step``) — following package ``__init__``
+        re-export hops (``from pkg import step`` where ``pkg/__init__``
+        does ``from .steps import step``)."""
+        info = self.by_path.get(mod.path)
+        if info is None:
+            return None
+        target = info.imports.get(local_name)
+        seen = set()
+        while target and target not in seen:
+            seen.add(target)
+            owner_name, _, symbol = target.rpartition(".")
+            owner = self.modules.get(owner_name)
+            if owner is None or owner is info:
+                return None
+            nums = owner.donators.get(symbol)
+            if nums:
+                return nums
+            target = owner.imports.get(symbol)  # re-export hop
+        return None
+
+    # -- transitive callback taint ------------------------------------------
+    def callback_tainted(self, summary: FunctionSummary) -> bool:
+        """True when ``summary`` performs a host callback itself or reaches
+        one through statically-resolvable project calls (fixpoint over the
+        call graph; cycles resolve to False-unless-proven)."""
+        return self._tainted(summary.fq, frozenset())
+
+    def _tainted(self, fq: str, seen: frozenset) -> bool:
+        if fq in self._taint_cache:
+            return self._taint_cache[fq]
+        if fq in seen:
+            return False
+        summary = self.lookup(fq)
+        if summary is None:
+            return False
+        if summary.has_host_callback:
+            self._taint_cache[fq] = True
+            return True
+        seen = seen | {fq}
+        for callee in summary.calls:
+            target = callee
+            # `self.m` calls match a method of any class in the same module
+            marker = f"{summary.module}.self."
+            if callee.startswith(marker):
+                mname = callee[len(marker):]
+                owner = self.modules[summary.module]
+                target = None
+                for qual, s in owner.functions.items():
+                    if s.is_method and qual.endswith("." + mname):
+                        target = f"{summary.module}.{qual}"
+                        break
+                if target is None:
+                    continue
+            if self.lookup(target) is not None and self._tainted(target, seen):
+                self._taint_cache[fq] = True
+                return True
+        self._taint_cache[fq] = False
+        return False
+
+
+def build_index(srcmods) -> ProjectIndex:
+    """Phase-1 entry point used by the engine."""
+    return ProjectIndex([m for m in srcmods if hasattr(m, "tree")])

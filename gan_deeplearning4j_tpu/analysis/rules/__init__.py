@@ -18,6 +18,11 @@ from gan_deeplearning4j_tpu.analysis.rules.asserts import BareAssert
 from gan_deeplearning4j_tpu.analysis.rules.recompile import RecompilationHazard
 from gan_deeplearning4j_tpu.analysis.rules.host_sync import HostSyncInTracedCode
 from gan_deeplearning4j_tpu.analysis.rules.donation import DonationSafety
+from gan_deeplearning4j_tpu.analysis.rules.at_update import DiscardedAtUpdate
+from gan_deeplearning4j_tpu.analysis.rules.scan_dtype import ScanCarryDtypeDrift
+from gan_deeplearning4j_tpu.analysis.rules.callbacks import CallbackInTimedRegion
+from gan_deeplearning4j_tpu.analysis.rules.donation_flow import DonationFlow
+from gan_deeplearning4j_tpu.analysis.rules.axes import AxisSizeMismatch
 
 RULES = [
     PrngKeyReuse(),
@@ -26,6 +31,11 @@ RULES = [
     RecompilationHazard(),
     HostSyncInTracedCode(),
     DonationSafety(),
+    DiscardedAtUpdate(),
+    ScanCarryDtypeDrift(),
+    CallbackInTimedRegion(),
+    DonationFlow(),
+    AxisSizeMismatch(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,86 @@
+"""JG007 — discarded ``.at[...].set()`` result.
+
+JAX arrays are immutable: ``x.at[i].set(v)`` (and ``.add``, ``.multiply``,
+``.min``, ``.max``, ``.apply``, ...) returns a NEW array and leaves ``x``
+untouched. Writing it as a bare statement — the reflex of every
+numpy/PyTorch in-place habit — is a silent no-op: the program traces, jits,
+and runs, producing numbers computed from the un-updated array. This is the
+ROADMAP-queued hazard class with the worst detectability-to-cost ratio:
+nothing crashes, the update just never happens.
+
+The rule flags any expression STATEMENT whose value is an indexed-update
+call. Fixable (``--fix``): when the updated object is a plain name or
+dotted attribute, the mechanical rewrite ``x = x.at[i].set(v)`` restores
+the intended semantics; exotic bases (calls, subscripts) are reported but
+left to a human.
+
+True negative: any use of the result — assignment, return, argument,
+carry — and ``.at[...].get()``, whose result being discarded is dead code
+but not a wrong-answer hazard (still flagged: a discarded ``.get()`` is
+either a typo for a fence or leftover debugging).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+#: the indexed-update methods of jax's ``.at`` property
+AT_METHODS = {
+    "set", "add", "subtract", "sub", "multiply", "mul", "divide", "div",
+    "power", "min", "max", "apply", "get",
+}
+
+
+def at_update_call(node: ast.AST):
+    """The ``(base_expr, method)`` of an ``<base>.at[...].<method>(...)``
+    call, else None. ``base_expr`` is the AST of ``<base>``."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in AT_METHODS):
+        return None
+    sub = node.func.value
+    if not (isinstance(sub, ast.Subscript)
+            and isinstance(sub.value, ast.Attribute)
+            and sub.value.attr == "at"):
+        return None
+    return sub.value.value, node.func.attr
+
+
+def fixable_base_text(base: ast.AST):
+    """Source text to rebind when the base is mechanically rebindable —
+    a bare name or a dotted attribute chain (``self.params``); anything
+    with calls/subscripts in it is not a safe mechanical target."""
+    node = base
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return ast.unparse(base)
+    return None
+
+
+class DiscardedAtUpdate:
+    code = "JG007"
+    name = "discarded-at-update"
+    summary = ".at[...].set() result discarded — functional update is a no-op"
+
+    def check(self, mod):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            hit = at_update_call(node.value)
+            if hit is None:
+                continue
+            base, method = hit
+            base_text = fixable_base_text(base)
+            target = base_text or ast.unparse(base)
+            f = mod.finding(
+                self.code,
+                f"`.at[...].{method}()` returns a new array and this "
+                f"statement discards it — `{target}` is unchanged (JAX "
+                f"arrays are immutable); rebind: "
+                f"`{target} = {ast.unparse(node.value)[:60]}`",
+                node,
+            )
+            yield f, node

@@ -1,0 +1,206 @@
+"""JG011 — statically-visible ``pmap``/``vmap`` axis mismatch.
+
+``jax.vmap``/``jax.pmap`` fail at TRACE time when ``in_axes`` does not
+match the mapped function's arity, or when the mapped axes of the actual
+arguments disagree in size — but "trace time" on this repo's target
+platform is minutes into a run, after the XLA compile queue, on an
+exclusively-held chip. Whole-program compilers reject these programs before
+they touch hardware (PAPERS.md: TensorFlow's static dataflow checking,
+Julia-to-TPU's shape inference); this rule recovers the statically-visible
+subset at lint time:
+
+1. **in_axes arity** — a literal ``in_axes`` tuple whose length differs
+   from the mapped callable's positional arity. The callable is resolved
+   through the project index, so ``jax.vmap(loss_fn, in_axes=(0, 0, None))``
+   is checked even when ``loss_fn`` lives in another module. Functions with
+   ``*args`` are skipped (arity unknowable), as are default-bearing arities
+   that could legitimately match.
+2. **call-site arity** — ``jax.vmap(f, in_axes=(0, 0))(x)``: literal tuple
+   length vs the immediate call's positional argument count.
+3. **axis sizes** — arguments that are names bound in the same scope to
+   literal-shaped constructors (``jnp.zeros((4, 3))``,
+   ``jax.random.normal(k, (8, 2))``, ...) must agree on the mapped axis
+   size. ``in_axes=None`` entries are skipped; integer entries pick the
+   axis they name.
+
+All checks fire only on statically-certain evidence — an unresolvable
+callable or a shape-unknown argument is silence, not a guess.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_MAP_WRAPPERS = {"jax.vmap", "jax.pmap"}
+
+#: constructors whose FIRST argument is a literal shape
+_SHAPE_FIRST = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty", "jax.numpy.full",
+    "numpy.zeros", "numpy.ones", "numpy.empty", "numpy.full",
+}
+#: jax.random samplers whose SECOND argument is a literal shape
+_SHAPE_SECOND = {
+    "jax.random.normal", "jax.random.uniform", "jax.random.bernoulli",
+    "jax.random.randint", "jax.random.truncated_normal",
+}
+
+
+def _literal_axes(node):
+    """in_axes as a list of int/None, or None when not a literal."""
+    if isinstance(node, ast.Constant) and (
+            node.value is None or isinstance(node.value, int)):
+        return node.value  # scalar broadcast spec — applies to every arg
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and (
+                    elt.value is None or isinstance(elt.value, int)):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _in_axes_node(map_call: ast.Call):
+    if len(map_call.args) > 1:
+        return map_call.args[1]
+    for kw in map_call.keywords:
+        if kw.arg == "in_axes":
+            return kw.value
+    return None
+
+
+def _shape_bindings(scope, mod) -> dict:
+    """name -> literal shape tuple, from constructor calls in ``scope``."""
+    shapes: dict = {}
+    for stmt in ast.walk(scope):
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)):
+            continue
+        call = stmt.value
+        resolved = mod.resolve(call.func)
+        shape_node = None
+        if resolved in _SHAPE_FIRST and call.args:
+            shape_node = call.args[0]
+        elif resolved in _SHAPE_SECOND and len(call.args) > 1:
+            shape_node = call.args[1]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "shape" and resolved and (
+                        resolved in _SHAPE_FIRST or resolved in _SHAPE_SECOND):
+                    shape_node = kw.value
+        if shape_node is None:
+            continue
+        shape = _common.literal_int_tuple(shape_node)
+        if shape is not None:
+            shapes[stmt.targets[0].id] = shape
+    return shapes
+
+
+class AxisSizeMismatch:
+    code = "JG011"
+    name = "axis-size-mismatch"
+    summary = "pmap/vmap in_axes arity or mapped axis sizes provably mismatch"
+
+    def check(self, mod):
+        for scope in _common.iter_scopes(mod.tree):
+            if getattr(scope, "body", None) is None:
+                continue
+            shapes = _shape_bindings(scope, mod)
+            # mapped-callable bindings in this scope: g = jax.vmap(f, ...)
+            mapped_by_name: dict = {}
+            for stmt in ast.walk(scope):
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Call)
+                        and mod.resolve(stmt.value.func) in _MAP_WRAPPERS):
+                    mapped_by_name[stmt.targets[0].id] = stmt.value
+            for n in ast.walk(scope):
+                if not isinstance(n, ast.Call):
+                    continue
+                # direct: jax.vmap(f, ...)(args)
+                if (isinstance(n.func, ast.Call)
+                        and mod.resolve(n.func.func) in _MAP_WRAPPERS):
+                    yield from self._check_map(n.func, n, mod, shapes)
+                # bare construction without immediate call: arity check only
+                elif mod.resolve(n.func) in _MAP_WRAPPERS:
+                    yield from self._check_map(n, None, mod, shapes)
+                # through a binding: g = jax.vmap(f, ...); g(args)
+                elif (isinstance(n.func, ast.Name)
+                        and n.func.id in mapped_by_name):
+                    yield from self._check_map(
+                        mapped_by_name[n.func.id], n, mod, shapes)
+
+    def _check_map(self, map_call, outer_call, mod, shapes):
+        axes = _literal_axes(_in_axes_node(map_call)) \
+            if _in_axes_node(map_call) is not None else 0
+        fn_name = (ast.unparse(map_call.args[0])
+                   if map_call.args else "<unknown>")
+        wrapper = mod.resolve(map_call.func)
+
+        # 1. in_axes tuple vs the mapped callable's arity (index-resolved)
+        if isinstance(axes, list) and map_call.args and mod.project is not None:
+            summary = mod.project.resolve_function(mod, map_call.args[0])
+            if (summary is not None and summary.node is not None
+                    and not summary.node.args.vararg
+                    and not (summary.min_arity <= len(axes)
+                             <= len(summary.params))):
+                f = mod.finding(
+                    self.code,
+                    f"in_axes has {len(axes)} entries but `{fn_name}` "
+                    f"({summary.fq}) takes "
+                    f"{summary.min_arity}"
+                    + (f"-{len(summary.params)}"
+                       if len(summary.params) != summary.min_arity else "")
+                    + " positional arguments — "
+                    f"{wrapper} raises at trace time; align in_axes with "
+                    f"the signature",
+                    map_call,
+                )
+                yield f, map_call
+                return
+        if outer_call is None:
+            return
+        n_args = len(outer_call.args)
+        if any(isinstance(a, ast.Starred) for a in outer_call.args):
+            return
+        # 2. in_axes tuple vs the immediate call-site arity
+        if isinstance(axes, list) and n_args and len(axes) != n_args:
+            f = mod.finding(
+                self.code,
+                f"in_axes has {len(axes)} entries but this call passes "
+                f"{n_args} positional argument{'s' if n_args != 1 else ''} "
+                f"— {wrapper} raises at trace time",
+                outer_call,
+            )
+            yield f, outer_call
+            return
+        # 3. mapped axis sizes from literal-shaped bindings
+        sized = []  # (arg_name, axis, size)
+        for i, arg in enumerate(outer_call.args):
+            axis = axes[i] if isinstance(axes, list) and i < len(axes) else axes
+            if axis is None or not isinstance(axis, int):
+                continue
+            if not isinstance(arg, ast.Name) or arg.id not in shapes:
+                continue
+            shape = shapes[arg.id]
+            ax = axis if axis >= 0 else len(shape) + axis
+            if 0 <= ax < len(shape):
+                sized.append((arg.id, axis, shape[ax]))
+        if len({s for _, _, s in sized}) > 1:
+            detail = ", ".join(
+                f"`{name}` axis {axis} has size {size}"
+                for name, axis, size in sized
+            )
+            f = mod.finding(
+                self.code,
+                f"mapped axis sizes disagree at this {wrapper} call: "
+                f"{detail} — every mapped argument must share the mapped "
+                f"axis size; fix the shapes or the in_axes spec",
+                outer_call,
+            )
+            yield f, outer_call

@@ -1,0 +1,112 @@
+"""JG009 — host callback inside a timed region.
+
+``io_callback``/``pure_callback``/``jax.debug.print``/``jax.debug.callback``
+suspend device execution and round-trip through the host every time they
+run. Inside a *timed region* — a loop that reads a wall clock, or the span
+between two clock reads — that round-trip is billed to the measurement: on
+the tunneled axon platform a single host hop costs ~70 ms (PROFILE.md
+round 3), an order of magnitude above the per-step times bench.py exists
+to resolve. The bench architecture's whole design rule is "nothing crosses
+the host boundary inside the window except the final fence"; a callback
+hidden two calls deep breaks it invisibly.
+
+Cross-module: the callback rarely sits in the timed loop itself — it sits
+in a jitted step the loop calls, often defined a module away. Phase 1's
+project index records which functions perform host callbacks directly and
+the rule consults the TRANSITIVE closure over the intra-project call graph,
+so ``timed(step)`` is flagged when ``step -> _log_losses -> io_callback``.
+
+True negatives: callbacks outside any timed region (debugging
+instrumentation in un-timed paths is fine), fences (``np.asarray``,
+``block_until_ready`` — those are the protocol, JG002 owns their
+correctness), and clock reads themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+
+def _clock_lines(nodes, mod):
+    return sorted(
+        n.lineno
+        for n in _common.walk_excluding_defs(nodes)
+        if isinstance(n, ast.Call) and mod.resolve(n.func) in _common.CLOCK_CALLS
+    )
+
+
+class CallbackInTimedRegion:
+    code = "JG009"
+    name = "callback-in-timed-region"
+    summary = ("io_callback/pure_callback reached from a timed region — "
+               "the measurement includes host round-trips")
+
+    def check(self, mod):
+        reported = set()
+        # region 1: any loop that reads a clock
+        for loop in _common.iter_loops(mod.tree):
+            if _clock_lines(loop, mod):
+                yield from self._scan_region(
+                    loop, mod, reported, where="timed loop")
+        # region 2: the straight-line span between the first and last clock
+        # read of a function body (the `t0 = clock(); work; t1 = clock()`
+        # shape) — nested defs excluded, loops already covered above
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if not body:
+                continue
+            lines = _clock_lines(body, mod)
+            if len(lines) < 2:
+                continue
+            lo, hi = lines[0], lines[-1]
+            span = [
+                n for n in _common.walk_excluding_defs(body)
+                if isinstance(n, ast.Call)
+                and lo <= getattr(n, "lineno", 0) <= hi
+            ]
+            yield from self._scan_calls(
+                span, mod, reported, where="timed span")
+
+    def _scan_region(self, region, mod, reported, where):
+        calls = [
+            n for n in _common.walk_excluding_defs(region)
+            if isinstance(n, ast.Call)
+        ]
+        yield from self._scan_calls(calls, mod, reported, where)
+
+    def _scan_calls(self, calls, mod, reported, where):
+        for call in calls:
+            if id(call) in reported:
+                continue
+            resolved = mod.resolve(call.func)
+            if resolved in _common.HOST_CALLBACKS:
+                reported.add(id(call))
+                f = mod.finding(
+                    self.code,
+                    f"`{resolved}` inside a {where} — every invocation "
+                    f"suspends the device and round-trips through the host "
+                    f"(~70 ms through the tunnel), so the measurement times "
+                    f"the callback, not the compute; move it outside the "
+                    f"timed region",
+                    call,
+                )
+                yield f, call
+                continue
+            if mod.project is None or resolved in _common.CLOCK_CALLS:
+                continue
+            summary = mod.project.resolve_function(mod, call.func)
+            if summary is not None and mod.project.callback_tainted(summary):
+                reported.add(id(call))
+                f = mod.finding(
+                    self.code,
+                    f"`{ast.unparse(call.func)}` is called inside a {where} "
+                    f"and `{summary.fq}` performs a host callback "
+                    f"(io_callback/pure_callback/jax.debug.*), directly or "
+                    f"through its callees — the measurement includes host "
+                    f"round-trips; strip the callback or time a "
+                    f"callback-free variant",
+                    call,
+                )
+                yield f, call

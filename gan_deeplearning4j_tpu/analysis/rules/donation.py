@@ -22,6 +22,10 @@ Only Name / ``self.x`` attribute arguments are tracked (a freshly
 constructed expression cannot be used-after-donate by name). A donated name
 read later in the same function — or anywhere in the same loop body when
 the call sits in a loop without rebinding — is a finding.
+
+Donation through ``functools.partial`` / import indirection is JG010
+(``donation_flow``), which shares :func:`scan_use_after_donate` below —
+same call-site semantics, different discovery.
 """
 
 from __future__ import annotations
@@ -30,33 +34,12 @@ import ast
 from typing import Optional
 
 from gan_deeplearning4j_tpu.analysis import _common
-
-_JIT = {"jax.jit", "jax.pmap"}
-
-
-def _donate_argnums_of(call: ast.Call, scope_body) -> Optional[tuple]:
-    """donate_argnums of a jax.jit call, resolving literal kwargs and the
-    ``**kwargs``-dict-literal builder idiom."""
-    for kw in call.keywords:
-        if kw.arg == "donate_argnums":
-            return _common.literal_int_tuple(kw.value)
-        if kw.arg is None and isinstance(kw.value, ast.Name) and scope_body:
-            # jax.jit(f, **kwargs): find `kwargs = {...}` in the same body
-            for stmt in scope_body:
-                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
-                        and isinstance(stmt.targets[0], ast.Name)
-                        and stmt.targets[0].id == kw.value.id
-                        and isinstance(stmt.value, ast.Dict)):
-                    for k, v in zip(stmt.value.keys, stmt.value.values):
-                        if (isinstance(k, ast.Constant)
-                                and k.value == "donate_argnums"):
-                            return _common.literal_int_tuple(v)
-    return None
+from gan_deeplearning4j_tpu.analysis.project import jit_donate_argnums
 
 
 def _jit_call(node: ast.AST, mod) -> Optional[ast.Call]:
     if (isinstance(node, ast.Call)
-            and mod.resolve(node.func) in _JIT):
+            and mod.resolve(node.func) in _common.JIT_WRAPPERS):
         return node
     return None
 
@@ -71,6 +54,155 @@ def _arg_key(node: ast.AST) -> Optional[str]:
     return None
 
 
+# -- the shared use-after-donate scanner (JG006 + JG010) --------------------
+
+def _attr_targets(stmt) -> set:
+    out = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Attribute):
+                key = _arg_key(node)
+                if key:
+                    out.add(key)
+    return out
+
+
+def _attr_binds(node) -> set:
+    out = set()
+    for s in ast.walk(node):
+        if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            out |= _attr_targets(s)
+    return out
+
+
+def _stmt_containing(scope, call):
+    best = None
+    for stmt in ast.walk(scope):
+        if not isinstance(stmt, ast.stmt):
+            continue
+        if (stmt.lineno <= call.lineno
+                and (stmt.end_lineno or stmt.lineno) >= (call.end_lineno
+                                                         or call.lineno)):
+            if best is None or stmt.lineno >= best.lineno:
+                best = stmt
+    return best
+
+
+def _enclosing_loop(scope, call):
+    """(loop_node, names_rebound_per_iteration) for the innermost
+    for/while loop or comprehension containing the call, else
+    (None, set()). Comprehension generator targets count as per-
+    iteration binds; everything else in a comprehension cannot rebind,
+    which is exactly why donating inside one is always wrong."""
+    best, binds = None, set()
+    for loop in _common.iter_loops(scope):
+        if (loop.lineno <= call.lineno
+                and (loop.end_lineno or loop.lineno) >= call.lineno
+                and any(n is call for n in ast.walk(loop))):
+            best, binds = loop, _common.bound_names(loop) | _attr_binds(loop)
+    for comp in ast.walk(scope):
+        if not isinstance(comp, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+            continue
+        if any(n is call for n in ast.walk(comp)):
+            targets = set()
+            for gen in comp.generators:
+                _common._target_names(gen.target, targets)
+            best, binds = comp, targets
+    return best, binds
+
+
+def _later_use(scope, call, akey, is_attr):
+    """First read of ``akey`` after the donating call, ignoring reads
+    that happen after an intervening rebind."""
+    call_end = call.end_lineno or call.lineno
+    rebind_lines = []
+    for n in ast.walk(scope):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            keys = (_attr_targets(n) if is_attr
+                    else _common.assignment_targets(n))
+            if akey in keys and n.lineno > call_end:
+                rebind_lines.append(n.lineno)
+    next_rebind = min(rebind_lines) if rebind_lines else float("inf")
+
+    for n in ast.walk(scope):
+        if is_attr:
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.ctx, ast.Load)
+                    and _arg_key(n) == akey
+                    and call_end < n.lineno <= next_rebind):
+                return n
+        else:
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id == akey and call_end < n.lineno <= next_rebind):
+                return n
+    return None
+
+
+def scan_use_after_donate(scope, donators: dict, mod, code: str):
+    """Yield ``(finding, node)`` for every use-after-donate in ``scope``.
+    ``donators`` maps callable identity (bare name or ``self.attr``) to its
+    donated argnums; ``code`` is the rule code to report under (JG006 for
+    same-module discovery, JG010 for partial/import indirection)."""
+    calls = []  # (call, [(donated_pos, arg_key, arg_node)])
+    for n in ast.walk(scope):
+        if not isinstance(n, ast.Call):
+            continue
+        fkey = _arg_key(n.func)
+        if fkey not in donators:
+            continue
+        donated = []
+        for pos in donators[fkey]:
+            if pos < len(n.args):
+                akey = _arg_key(n.args[pos])
+                if akey:
+                    donated.append((pos, akey, n.args[pos]))
+        if donated:
+            calls.append((n, donated))
+
+    for call, donated in calls:
+        stmt = _stmt_containing(scope, call)
+        rebound = _common.assignment_targets(stmt) if stmt else set()
+        rebound_attrs = _attr_targets(stmt) if stmt else set()
+        loop, loop_binds = _enclosing_loop(scope, call)
+        for pos, akey, anode in donated:
+            is_attr = "." in akey
+            if (akey in rebound_attrs) if is_attr else (akey in rebound):
+                continue  # state = step(state, ...) — the safe idiom
+            if loop is not None and akey not in loop_binds:
+                # the donating call re-reads the name next iteration:
+                # the loop itself is the use-after-donate
+                f = mod.finding(
+                    code,
+                    f"`{akey}` is donated (donate_argnums position "
+                    f"{pos}) to `{_arg_key(call.func)}` inside a loop "
+                    f"without being rebound — the next iteration "
+                    f"passes an already-donated buffer; rebind the "
+                    f"result over `{akey}` or drop the donation",
+                    call,
+                )
+                yield f, call
+                break
+            use = _later_use(scope, call, akey, is_attr)
+            if use is not None:
+                f = mod.finding(
+                    code,
+                    f"`{akey}` is donated (donate_argnums position "
+                    f"{pos}) to `{_arg_key(call.func)}` but read again "
+                    f"at line {use.lineno} — a donated buffer is "
+                    f"invalid after the call; rebind the result or "
+                    f"drop the donation",
+                    call,
+                )
+                yield f, call
+                break
+
+
 class DonationSafety:
     code = "JG006"
     name = "donation-safety"
@@ -83,7 +215,7 @@ class DonationSafety:
         for scope in _common.iter_scopes(mod.tree):
             if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            yield from self._check_scope(scope, donators, mod)
+            yield from scan_use_after_donate(scope, donators, mod, self.code)
 
     # -- donator discovery --------------------------------------------------
     def _collect_donators(self, mod) -> dict:
@@ -99,7 +231,7 @@ class DonationSafety:
                     jc = _jit_call(n.value, mod)
                     if jc is None:
                         continue
-                    nums = _donate_argnums_of(jc, body)
+                    nums = jit_donate_argnums(jc, body, mod.resolve)
                     if not nums:
                         continue
                     key = _arg_key(n.targets[0])
@@ -114,7 +246,7 @@ class DonationSafety:
                 if isinstance(ret, ast.Return) and ret.value is not None:
                     jc = _jit_call(ret.value, mod)
                     if jc is not None:
-                        nums = _donate_argnums_of(jc, n.body)
+                        nums = jit_donate_argnums(jc, n.body, mod.resolve)
                         if nums:
                             builder_nums[n.name] = nums
         # (3) self.attr = self._build_x()
@@ -128,148 +260,3 @@ class DonationSafety:
                 if key:
                     donators[key] = builder_nums[n.value.func.attr]
         return donators
-
-    # -- use-after-donate scan ----------------------------------------------
-    def _check_scope(self, scope, donators, mod):
-        calls = []  # (call, [(donated_pos, arg_key, arg_node)])
-        for n in ast.walk(scope):
-            if not isinstance(n, ast.Call):
-                continue
-            fkey = _arg_key(n.func)
-            if fkey not in donators:
-                continue
-            donated = []
-            for pos in donators[fkey]:
-                if pos < len(n.args):
-                    akey = _arg_key(n.args[pos])
-                    if akey:
-                        donated.append((pos, akey, n.args[pos]))
-            if donated:
-                calls.append((n, donated))
-
-        for call, donated in calls:
-            stmt = self._stmt_containing(scope, call)
-            rebound = _common.assignment_targets(stmt) if stmt else set()
-            rebound_attrs = self._attr_targets(stmt) if stmt else set()
-            loop, loop_binds = self._enclosing_loop(scope, call)
-            for pos, akey, anode in donated:
-                is_attr = "." in akey
-                if (akey in rebound_attrs) if is_attr else (akey in rebound):
-                    continue  # state = step(state, ...) — the safe idiom
-                if loop is not None and akey not in loop_binds:
-                    # the donating call re-reads the name next iteration:
-                    # the loop itself is the use-after-donate
-                    f = mod.finding(
-                        self.code,
-                        f"`{akey}` is donated (donate_argnums position "
-                        f"{pos}) to `{_arg_key(call.func)}` inside a loop "
-                        f"without being rebound — the next iteration "
-                        f"passes an already-donated buffer; rebind the "
-                        f"result over `{akey}` or drop the donation",
-                        call,
-                    )
-                    yield f, call
-                    break
-                use = self._later_use(scope, call, akey, is_attr)
-                if use is not None:
-                    f = mod.finding(
-                        self.code,
-                        f"`{akey}` is donated (donate_argnums position "
-                        f"{pos}) to `{_arg_key(call.func)}` but read again "
-                        f"at line {use.lineno} — a donated buffer is "
-                        f"invalid after the call; rebind the result or "
-                        f"drop the donation",
-                        call,
-                    )
-                    yield f, call
-                    break
-
-    @staticmethod
-    def _attr_targets(stmt) -> set:
-        out = set()
-        targets = []
-        if isinstance(stmt, ast.Assign):
-            targets = stmt.targets
-        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
-            targets = [stmt.target]
-        for t in targets:
-            for node in ast.walk(t):
-                if isinstance(node, ast.Attribute):
-                    key = _arg_key(node)
-                    if key:
-                        out.add(key)
-        return out
-
-    @staticmethod
-    def _stmt_containing(scope, call):
-        best = None
-        for stmt in ast.walk(scope):
-            if not isinstance(stmt, ast.stmt):
-                continue
-            if (stmt.lineno <= call.lineno
-                    and (stmt.end_lineno or stmt.lineno) >= (call.end_lineno
-                                                             or call.lineno)):
-                if best is None or stmt.lineno >= best.lineno:
-                    best = stmt
-        return best
-
-    def _enclosing_loop(self, scope, call):
-        """(loop_node, names_rebound_per_iteration) for the innermost
-        for/while loop or comprehension containing the call, else
-        (None, set()). Comprehension generator targets count as per-
-        iteration binds; everything else in a comprehension cannot rebind,
-        which is exactly why donating inside one is always wrong."""
-        best, binds = None, set()
-        for loop in _common.iter_loops(scope):
-            if (loop.lineno <= call.lineno
-                    and (loop.end_lineno or loop.lineno) >= call.lineno
-                    and any(n is call for n in ast.walk(loop))):
-                best, binds = loop, _common.bound_names(loop) | \
-                    self._attr_binds(loop)
-        for comp in ast.walk(scope):
-            if not isinstance(comp, (ast.ListComp, ast.SetComp, ast.DictComp,
-                                     ast.GeneratorExp)):
-                continue
-            if any(n is call for n in ast.walk(comp)):
-                targets = set()
-                for gen in comp.generators:
-                    _common._target_names(gen.target, targets)
-                best, binds = comp, targets
-        return best, binds
-
-    @staticmethod
-    def _attr_binds(node) -> set:
-        out = set()
-        for s in ast.walk(node):
-            if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                out |= DonationSafety._attr_targets(s)
-        return out
-
-    def _later_use(self, scope, call, akey, is_attr):
-        """First read of ``akey`` after the donating call, ignoring reads
-        that happen after an intervening rebind."""
-        call_end = call.end_lineno or call.lineno
-        rebind_lines = []
-        for n in ast.walk(scope):
-            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                keys = (self._attr_targets(n) if is_attr
-                        else _common.assignment_targets(n))
-                if akey in keys and n.lineno > call_end:
-                    rebind_lines.append(n.lineno)
-        next_rebind = min(rebind_lines) if rebind_lines else float("inf")
-
-        def reads(root, lo, hi):
-            for n in ast.walk(root):
-                if is_attr:
-                    if (isinstance(n, ast.Attribute)
-                            and isinstance(n.ctx, ast.Load)
-                            and _arg_key(n) == akey
-                            and lo < n.lineno <= hi):
-                        return n
-                else:
-                    if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
-                            and n.id == akey and lo < n.lineno <= hi):
-                        return n
-            return None
-
-        return reads(scope, call_end, next_rebind)

@@ -1,0 +1,133 @@
+"""JG010 — donation tracking through ``functools.partial`` / indirection.
+
+JG006 proves use-after-donate for donating callables *discovered in the
+same module*. The hazards the ROADMAP queued next hide the donation behind
+one more hop, where a reviewer reading the call site sees nothing about
+donation at all:
+
+1. **partial over a donator** — ``p = functools.partial(step, cfg)`` where
+   ``step = jax.jit(fn, donate_argnums=(0,))``:
+
+   - if a donated position is among the BOUND arguments, the partial
+     donates the same captured buffer on EVERY call — the second call
+     passes an already-donated array (flagged at the partial construction,
+     unconditionally: there is no safe way to call it twice);
+   - otherwise the donated positions SHIFT by the number of bound
+     positional arguments at the partial's call sites — ``p``'s argument
+     ``i`` is ``step``'s ``i + len(bound)`` — and use-after-donate must be
+     checked against the shifted positions.
+
+2. **imported donators** — ``from harness.steps import step`` then
+   ``step(state, ...); state.mean()``: the donation lives in another file.
+   Phase 1 records module-level donators (including ``step = make_step()``
+   builder results) per module; this rule checks call sites in every
+   importing module against them.
+
+Same call-site semantics as JG006 (the shared
+:func:`~.donation.scan_use_after_donate` scanner); only discovery differs,
+so a defect is reported under exactly one of the two codes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+from gan_deeplearning4j_tpu.analysis.rules import donation as _donation
+
+
+class DonationFlow:
+    code = "JG010"
+    name = "donation-flow"
+    summary = ("donated buffer misused through functools.partial or an "
+               "imported donating callable")
+
+    def check(self, mod):
+        local = _donation.DonationSafety()._collect_donators(mod)
+        flow: dict = {}
+        info = None
+
+        # (a) module-level donators imported from other indexed modules
+        if mod.project is not None:
+            info = mod.project.by_path.get(mod.path)
+            for local_name in (info.imports if info else {}):
+                nums = mod.project.imported_donator(mod, local_name)
+                if nums and local_name not in local:
+                    flow[local_name] = nums
+
+        # (b) name = builder() where the builder lives in another module
+        if mod.project is not None and info is not None:
+            for stmt in mod.tree.body:
+                if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Call)):
+                    continue
+                name = stmt.targets[0].id
+                if name in local or name in flow:
+                    continue
+                summary = mod.project.resolve_function(mod, stmt.value.func)
+                if (summary is not None and summary.module != info.name
+                        and summary.returns_donation):
+                    flow[name] = summary.returns_donation
+
+        # (c) partials over any known donator. Partial aliases are SCOPED to
+        # the function (or module body) that constructs them: registering
+        # them module-wide would flag an unrelated local that merely shares
+        # the variable name in another function.
+        for f, node, name, shifted in self._partials(mod.tree, mod,
+                                                     {**local, **flow}):
+            if f is not None:
+                yield f, node
+            else:
+                flow[name] = shifted  # module-level alias: visible everywhere
+        for scope in _common.iter_scopes(mod.tree):
+            if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            scope_flow = dict(flow)
+            for f, node, name, shifted in self._partials(
+                    scope, mod, {**local, **scope_flow}):
+                if f is not None:
+                    yield f, node
+                else:
+                    scope_flow[name] = shifted
+            if scope_flow:
+                yield from _donation.scan_use_after_donate(
+                    scope, scope_flow, mod, self.code
+                )
+
+    def _partials(self, root, mod, known):
+        """Partial-over-donator assignments among ``root``'s OWN statements
+        (nested function bodies excluded — they are their own scopes).
+        Yields ``(finding, node, None, None)`` for a bound-donated-position
+        partial, ``(None, None, name, shifted_argnums)`` for a clean alias
+        whose donated positions shifted by the bound-argument count."""
+        for stmt in _common.walk_excluding_defs(root):
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)
+                    and mod.resolve(stmt.value.func) == "functools.partial"
+                    and stmt.value.args):
+                continue
+            target_key = _donation._arg_key(stmt.value.args[0])
+            nums = known.get(target_key)
+            if not nums:
+                continue
+            bound = len(stmt.value.args) - 1
+            donated_bound = [i for i in nums if i < bound]
+            if donated_bound:
+                f = mod.finding(
+                    self.code,
+                    f"functools.partial binds `{target_key}`'s argument "
+                    f"at donated position{'s' if len(donated_bound) > 1 else ''} "
+                    f"{tuple(donated_bound)} — the captured buffer is "
+                    f"donated on EVERY call, so any second call passes "
+                    f"an already-donated array; bind non-donated "
+                    f"arguments only, or drop the donation",
+                    stmt.value,
+                )
+                yield f, stmt.value, None, None
+            else:
+                # positions shift: partial arg i is target arg i+bound
+                yield None, None, stmt.targets[0].id, tuple(
+                    i - bound for i in nums if i >= bound
+                )

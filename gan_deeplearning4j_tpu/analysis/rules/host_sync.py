@@ -24,14 +24,7 @@ import ast
 
 from gan_deeplearning4j_tpu.analysis import _common
 
-_TRACING_WRAPPERS = {
-    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
-    "jax.jacfwd", "jax.jacrev", "jax.hessian", "jax.checkpoint", "jax.remat",
-    "jax.shard_map", "jax.experimental.shard_map.shard_map",
-    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
-    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
-    "jax.lax.associative_scan", "jax.custom_jvp", "jax.custom_vjp",
-}
+_TRACING_WRAPPERS = _common.TRACING_WRAPPERS
 _HOST_CALLS = {
     "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
     "numpy.save", "numpy.savez", "jax.device_get",

@@ -1,0 +1,215 @@
+"""JG008 — Python-float literal on a loop-carry path.
+
+``lax.scan``/``fori_loop``/``while_loop`` require the carry's dtype to be
+invariant across iterations, and this repo runs its hot bodies under a
+swappable compute dtype (``runtime/dtype.py`` — bf16 on the MXU path). A
+bare Python float literal in carry arithmetic is resolved against whatever
+dtype the carry happens to have at trace time:
+
+- under a low-precision compute scope the literal silently ROUNDS to the
+  carry dtype — ``0.999`` is 0.99609375 in bf16, a 3e-3 relative error that
+  compounds per iteration (over a 128-step scan window, ``0.999**128`` ≈
+  0.88 but ``0.99609**128`` ≈ 0.61: the decay schedule the literal was
+  meant to encode is simply a different schedule);
+- with strongly-typed scalars in the mix (``np.float64(...)``, x64 mode)
+  the promotion goes the other way and the carry dtype drifts upward, which
+  ``lax.scan`` rejects at trace time with a carry-mismatch error — the
+  lucky outcome.
+
+The rule flags float literals that participate in BinOp arithmetic on the
+carry path of a loop-combinator body: inside the returned carry expression,
+or in the value of an assignment whose target (transitively) feeds it.
+Bodies are resolved through name indirection — a lambda, a local ``def``,
+or (via the project index) a function imported from another module; the
+finding lands in the file that owns the body.
+
+True negatives: literals whose dtype is pinned — inside a call carrying a
+``dtype=`` kwarg, an ``.astype(...)``, or a ``jnp.float32``-style cast —
+integer literals (exact in every float dtype within range), comparisons,
+and literals on non-carry values (per-step outputs do not compound).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_LOOP_COMBINATORS = {
+    "jax.lax.scan": "scan",
+    "jax.lax.fori_loop": "fori",
+    "jax.lax.while_loop": "while",
+}
+
+#: calls that pin a literal's dtype (beyond any call with a dtype= kwarg)
+_CAST_CALLS = {
+    "jax.numpy.float32", "jax.numpy.float16", "jax.numpy.bfloat16",
+    "jax.numpy.float64", "jax.numpy.asarray", "jax.numpy.array",
+    "numpy.float32", "numpy.float64", "numpy.asarray", "numpy.array",
+}
+
+
+def _body_arg(call: ast.Call, kind: str):
+    """The body-function expression of a loop-combinator call."""
+    if kind == "scan":
+        pos, kw_name = 0, "f"
+    elif kind == "fori":
+        pos, kw_name = 2, "body_fun"
+    else:  # while
+        pos, kw_name = 1, "body_fun"
+    if len(call.args) > pos:
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == kw_name:
+            return kw.value
+    return None
+
+
+def _fn_params(fn) -> list:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+def _carry_param(fn, kind: str):
+    params = _fn_params(fn)
+    idx = 1 if kind == "fori" else 0
+    return params[idx] if len(params) > idx else None
+
+
+def _carry_exprs(fn, kind: str) -> list:
+    """The expressions whose value becomes next iteration's carry."""
+    if isinstance(fn, ast.Lambda):
+        vals = [fn.body]
+    else:
+        vals = [
+            r.value
+            for r in _common.walk_excluding_defs(fn.body)
+            if isinstance(r, ast.Return) and r.value is not None
+        ]
+    if kind != "scan":
+        return vals  # fori/while bodies return the carry itself
+    out = []
+    for v in vals:
+        out.append(v.elts[0] if isinstance(v, ast.Tuple) and v.elts else v)
+    return out
+
+
+def _exempt_literals(fn, resolve) -> set:
+    """ids of float Constants whose dtype is pinned by an enclosing call."""
+    exempt = set()
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        pinned = (
+            any(kw.arg == "dtype" for kw in n.keywords)
+            or (isinstance(n.func, ast.Attribute) and n.func.attr == "astype")
+            or resolve(n.func) in _CAST_CALLS
+        )
+        if pinned:
+            for c in ast.walk(n):
+                if isinstance(c, ast.Constant) and isinstance(c.value, float):
+                    exempt.add(id(c))
+    return exempt
+
+
+def _float_operands(binop: ast.BinOp):
+    for side in (binop.left, binop.right):
+        node = side
+        while isinstance(node, ast.UnaryOp):
+            node = node.operand
+        if isinstance(node, ast.Constant) and isinstance(node.value, float):
+            yield node
+
+
+class ScanCarryDtypeDrift:
+    code = "JG008"
+    name = "scan-carry-dtype-drift"
+    summary = ("bare Python float literal in loop-carry arithmetic — "
+               "rounds to the compute dtype and compounds per iteration")
+
+    def check(self, mod):
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            kind = _LOOP_COMBINATORS.get(mod.resolve(call.func))
+            if kind is None:
+                continue
+            body = _body_arg(call, kind)
+            if body is None:
+                continue
+            fn, owner = self._resolve_body(body, mod)
+            if fn is None:
+                continue
+            yield from self._check_body(fn, kind, owner)
+
+    def _resolve_body(self, body, mod):
+        """(function node, owning SourceModule) — lambda inline, a def in
+        this module, or an imported function through the project index."""
+        if isinstance(body, ast.Lambda):
+            return body, mod
+        if isinstance(body, ast.Name):
+            for n in ast.walk(mod.tree):
+                if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and n.name == body.id):
+                    return n, mod
+        if mod.project is not None:
+            summary = mod.project.resolve_function(mod, body)
+            if summary is not None and summary.node is not None:
+                info = mod.project.modules.get(summary.module)
+                owner = info.srcmod if info else None
+                if owner is not None:
+                    return summary.node, owner
+        return None, None
+
+    def _check_body(self, fn, kind, mod):
+        carry = _carry_param(fn, kind)
+        if carry is None:
+            return
+        carry_exprs = _carry_exprs(fn, kind)
+        if not carry_exprs:
+            return
+        body_root = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+        # names that (transitively) feed the returned carry
+        assigns = [
+            (s, _common.assignment_targets(s), s.value)
+            for s in _common.walk_excluding_defs(body_root)
+            if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+            and getattr(s, "value", None) is not None
+        ]
+        feeding = set()
+        for expr in carry_exprs:
+            feeding |= _common.loaded_names(expr)
+        changed = True
+        while changed:
+            changed = False
+            for _, targets, value in assigns:
+                if targets & feeding:
+                    loaded = _common.loaded_names(value)
+                    if not loaded <= feeding:
+                        feeding |= loaded
+                        changed = True
+        exempt = _exempt_literals(fn, mod.resolve)
+        roots = list(carry_exprs) + [
+            value for _, targets, value in assigns if targets & feeding
+        ]
+        reported = set()
+        for root in roots:
+            for n in ast.walk(root):
+                if not isinstance(n, ast.BinOp):
+                    continue
+                for lit in _float_operands(n):
+                    if id(lit) in exempt or id(lit) in reported:
+                        continue
+                    reported.add(id(lit))
+                    f = mod.finding(
+                        self.code,
+                        f"float literal `{lit.value}` in arithmetic on the "
+                        f"{kind}-loop carry path (carry `{carry}`) — the "
+                        f"literal is resolved against the carry's compute "
+                        f"dtype at trace time (0.999 is ~0.9961 in bf16) "
+                        f"and the rounding compounds every iteration; pin "
+                        f"it: jnp.asarray({lit.value}, dtype=...) or do "
+                        f"this arithmetic in f32 and cast back",
+                        lit,
+                    )
+                    yield f, n

@@ -31,10 +31,7 @@ import ast
 
 from gan_deeplearning4j_tpu.analysis import _common
 
-_CLOCKS = {
-    "time.perf_counter", "time.perf_counter_ns", "time.monotonic",
-    "time.monotonic_ns", "time.time", "timeit.default_timer",
-}
+_CLOCKS = _common.CLOCK_CALLS
 _FENCE_CALLS = {
     "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
     "jax.block_until_ready", "jax.device_get",

@@ -1,0 +1,26 @@
+#!/bin/bash
+# jaxlint gate wrapper (jaxlint v2) — the two shapes CI and humans need:
+#
+#   scripts/lint_gate.sh                 # FAST: only .py files changed vs
+#                                        #   the merge base with ${LINT_BASE:-HEAD}
+#                                        #   (HEAD = uncommitted work only) —
+#                                        #   the pre-commit shape
+#   scripts/lint_gate.sh --full          # the whole tier-1 target set —
+#                                        #   what tests/test_analysis.py's
+#                                        #   TestTreeIsClean enforces
+#   LINT_BASE=main scripts/lint_gate.sh  # changed vs merge-base with main
+#   LINT_FORMAT=sarif scripts/lint_gate.sh --full > lint.sarif  # CI annotators
+#
+# Extra arguments pass through to the analyzer (--rules JG00x, --fix, ...).
+# Exit codes are the analyzer's: 0 clean (modulo baseline + suppressions),
+# 1 active findings or stale baseline entries, 2 usage/environment error.
+cd "$(dirname "$0")/.." || exit 2
+TARGETS=(gan_deeplearning4j_tpu bench.py scripts)
+FORMAT="${LINT_FORMAT:-text}"
+if [ "$1" = "--full" ]; then
+  shift
+  exec python -m gan_deeplearning4j_tpu.analysis "${TARGETS[@]}" \
+    --format "$FORMAT" "$@"
+fi
+exec python -m gan_deeplearning4j_tpu.analysis "${TARGETS[@]}" \
+  --changed-only --diff-base "${LINT_BASE:-HEAD}" --format "$FORMAT" "$@"

@@ -64,11 +64,18 @@ def bench_gemm(n: int, dtype, peak) -> dict:
     a = jnp.asarray(np.random.default_rng(0).standard_normal((n, n)), dtype)
     b = jnp.asarray(np.random.default_rng(1).standard_normal((n, n)), dtype)
 
+    # anti-DCE constants pinned to the benchmark dtype OUTSIDE the scan body:
+    # a bare float literal in carry arithmetic re-rounds to the compute dtype
+    # per iteration (jaxlint JG008) — harmless to FLOPs here, but the ceiling
+    # harness must model the hygiene the timed paths are held to
+    scale = jnp.asarray(1e-3, dtype)
+    eps = jnp.asarray(1e-6, dtype)
+
     @jax.jit
     def loop(a, b):
         def step(carry, _):
             # rebind so the K matmuls chain (no DCE, no hoisting)
-            return jnp.tanh(carry @ b) * 1e-3 + a * 1e-6, ()
+            return jnp.tanh(carry @ b) * scale + a * eps, ()
 
         out, _ = jax.lax.scan(step, a, None, length=SCAN_K)
         return out

@@ -19,6 +19,13 @@
 # failure (a timeout-killed or CPU-degraded attempt must not clobber
 # committed TPU evidence).
 cd /root/repo || exit 1
+# Preflight (jaxlint v2): the campaign holds the chip exclusively for hours —
+# refuse to start it on a tree that fails the static gate tier-1 enforces
+# (full-tree mode: the campaign runs committed AND uncommitted code).
+if ! bash scripts/lint_gate.sh --full > lint_gate.log 2>&1; then
+  echo "$(date +%H:%M:%S) jaxlint gate failed — campaign aborted (see lint_gate.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

@@ -20,6 +20,7 @@ from gan_deeplearning4j_tpu.analysis import (
     RULES,
     analyze_paths,
     analyze_source,
+    analyze_sources,
     load_baseline,
 )
 
@@ -468,6 +469,548 @@ class TestDonationSafety:
 
 
 # ===========================================================================
+# JG007 — discarded .at[].set() result
+# ===========================================================================
+
+class TestDiscardedAtUpdate:
+    def test_true_positive_discarded_set(self):
+        r = run(
+            "import jax.numpy as jnp\n"
+            "def f(x, i, v):\n"
+            "    x.at[i].set(v)\n"
+            "    return x\n"
+        )
+        assert codes(r) == ["JG007"]
+        assert "discards" in r.active[0].message
+        assert "x = x.at[i].set(v)" in r.active[0].message
+
+    def test_true_positive_discarded_add_on_attribute(self):
+        r = run(
+            "import jax.numpy as jnp\n"
+            "class T:\n"
+            "    def bump(self, i):\n"
+            "        self.counts.at[i].add(1)\n"
+        )
+        assert codes(r) == ["JG007"]
+
+    def test_true_negative_rebound(self):
+        r = run(
+            "import jax.numpy as jnp\n"
+            "def f(x, i, v):\n"
+            "    x = x.at[i].set(v)\n"
+            "    return x\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_result_used_as_argument_or_return(self):
+        r = run(
+            "import jax.numpy as jnp\n"
+            "def f(x, i, v, g):\n"
+            "    g(x.at[i].set(v))\n"
+            "    return x.at[i].add(v)\n"
+        )
+        assert codes(r) == []
+
+    def test_plain_attribute_named_at_is_not_flagged(self):
+        # `obj.at[k].set(v)` requires the `.at` property shape exactly;
+        # an unrelated dict-of-methods `handlers[k].set(v)` must not fire
+        r = run(
+            "def f(handlers, k, v):\n"
+            "    handlers[k].set(v)\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG008 — float literal on the loop-carry path
+# ===========================================================================
+
+class TestScanCarryDtypeDrift:
+    def test_true_positive_decay_literal_in_scan_carry(self):
+        # the compounding case: 0.999 is ~0.9961 in bf16, so a 128-step
+        # window turns a 0.88 decay into 0.61
+        r = run(
+            "import jax\n"
+            "def outer(xs):\n"
+            "    def body(carry, x):\n"
+            "        carry = carry * 0.999 + x\n"
+            "        return carry, ()\n"
+            "    return jax.lax.scan(body, 0.0, xs)\n"
+        )
+        assert codes(r) == ["JG008"]
+        assert "0.999" in r.active[0].message
+
+    def test_true_positive_fori_loop_body_by_name(self):
+        r = run(
+            "import jax\n"
+            "def body(i, val):\n"
+            "    return val * 0.5\n"
+            "def outer(v0):\n"
+            "    return jax.lax.fori_loop(0, 10, body, v0)\n"
+        )
+        assert codes(r) == ["JG008"]
+
+    def test_true_positive_cross_module_scan_body(self):
+        # the body lives a module away; the finding lands in ITS file
+        r = analyze_sources({
+            "pkg/bodies.py": (
+                "def ema_body(carry, x):\n"
+                "    return carry * 0.99 + x * 0.01, carry\n"
+            ),
+            "pkg/driver.py": (
+                "import jax\n"
+                "from pkg.bodies import ema_body\n"
+                "def outer(xs):\n"
+                "    return jax.lax.scan(ema_body, 0.0, xs)\n"
+            ),
+        })
+        assert codes(r) == ["JG008", "JG008"]
+        assert {f.path for f in r.active} == {"pkg/bodies.py"}
+
+    def test_true_negative_dtype_pinned_literal(self):
+        r = run(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def outer(xs):\n"
+            "    def body(carry, x):\n"
+            "        carry = carry * jnp.asarray(0.999, carry.dtype) + x\n"
+            "        return carry, ()\n"
+            "    return jax.lax.scan(body, jnp.zeros(()), xs)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_literal_on_per_step_output_only(self):
+        # per-step outputs do not compound across iterations
+        r = run(
+            "import jax\n"
+            "def outer(xs):\n"
+            "    def body(carry, x):\n"
+            "        y = x * 0.5\n"
+            "        return carry + x, y\n"
+            "    return jax.lax.scan(body, 0.0, xs)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_integer_literal(self):
+        r = run(
+            "import jax\n"
+            "def outer(xs):\n"
+            "    def body(carry, x):\n"
+            "        return carry * 2 + x, ()\n"
+            "    return jax.lax.scan(body, 0.0, xs)\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG009 — host callback inside a timed region
+# ===========================================================================
+
+class TestCallbackInTimedRegion:
+    def test_true_positive_debug_print_in_timed_loop(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def bench(step):\n"
+            "    times = []\n"
+            "    for _ in range(10):\n"
+            "        t0 = time.perf_counter()\n"
+            "        jax.debug.print('step')\n"
+            "        step()\n"
+            "        times.append(time.perf_counter() - t0)\n"
+            "    return times\n"
+        )
+        assert codes(r) == ["JG009"]
+        assert "host" in r.active[0].message
+
+    def test_true_positive_cross_module_transitive_callback(self):
+        # bench times step(); step -> log_losses -> jax.debug.print, two
+        # modules away — only the project index can see it
+        r = analyze_sources({
+            "pkg/steps.py": (
+                "import jax\n"
+                "def log_losses(x):\n"
+                "    jax.debug.print('loss {x}', x=x)\n"
+                "    return x\n"
+                "def step(state):\n"
+                "    return log_losses(state)\n"
+            ),
+            "pkg/bench.py": (
+                "import time\n"
+                "from pkg.steps import step\n"
+                "def bench(state):\n"
+                "    t0 = time.perf_counter()\n"
+                "    state = step(state)\n"
+                "    t1 = time.perf_counter()\n"
+                "    return state, t1 - t0\n"
+            ),
+        })
+        assert codes(r) == ["JG009"]
+        assert r.active[0].path == "pkg/bench.py"
+        assert "pkg.steps.step" in r.active[0].message
+
+    def test_true_positive_relative_import_callback(self):
+        # the call graph must absolutize `from .steps import step` — the
+        # dominant intra-package import style of this repo
+        r = analyze_sources({
+            "pkg/__init__.py": "",
+            "pkg/steps.py": (
+                "import jax\n"
+                "def step(state):\n"
+                "    jax.debug.print('s')\n"
+                "    return state\n"
+            ),
+            "pkg/bench.py": (
+                "import time\n"
+                "from .steps import step\n"
+                "def bench(state):\n"
+                "    t0 = time.perf_counter()\n"
+                "    state = step(state)\n"
+                "    t1 = time.perf_counter()\n"
+                "    return state, t1 - t0\n"
+            ),
+        })
+        assert codes(r) == ["JG009"]
+
+    def test_true_negative_callback_outside_timed_region(self):
+        r = analyze_sources({
+            "pkg/steps.py": (
+                "import jax\n"
+                "def step(state):\n"
+                "    jax.debug.print('s')\n"
+                "    return state\n"
+            ),
+            "pkg/run.py": (
+                "from pkg.steps import step\n"
+                "def run(state):\n"
+                "    return step(state)\n"
+            ),
+        })
+        assert codes(r) == []
+
+    def test_true_negative_fence_in_timed_loop_is_not_a_callback(self):
+        # the protocol itself: fencing on np.asarray is JG002's domain
+        r = run(
+            "import time\n"
+            "import numpy as np\n"
+            "def bench(step):\n"
+            "    times = []\n"
+            "    for _ in range(3):\n"
+            "        t0 = time.perf_counter()\n"
+            "        out = step()\n"
+            "        np.asarray(out)\n"
+            "        times.append(time.perf_counter() - t0)\n"
+            "    return times\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG010 — donation through functools.partial / import indirection
+# ===========================================================================
+
+class TestDonationFlow:
+    def test_true_positive_partial_binds_donated_position(self):
+        # the captured buffer is donated on EVERY call — no safe second call
+        r = run(
+            "import jax\n"
+            "import functools\n"
+            "def g(s, x):\n"
+            "    return s + x\n"
+            "step = jax.jit(g, donate_argnums=(0,))\n"
+            "def runner(state, xs):\n"
+            "    p = functools.partial(step, state)\n"
+            "    return [p(x) for x in xs]\n"
+        )
+        assert codes(r) == ["JG010"]
+        assert "EVERY call" in r.active[0].message
+
+    def test_true_positive_shifted_position_use_after_donate(self):
+        r = run(
+            "import jax\n"
+            "import functools\n"
+            "def g(cfg, s, x):\n"
+            "    return s + x\n"
+            "step = jax.jit(g, donate_argnums=(1,))\n"
+            "def runner(cfg, state, xs):\n"
+            "    p = functools.partial(step, cfg)\n"
+            "    out = p(state, xs)\n"
+            "    return out + state.mean()\n"
+        )
+        assert codes(r) == ["JG010"]
+
+    def test_true_positive_imported_donator(self):
+        r = analyze_sources({
+            "pkg/steps.py": (
+                "import jax\n"
+                "def _step(s, x):\n"
+                "    return s + x\n"
+                "step = jax.jit(_step, donate_argnums=(0,))\n"
+            ),
+            "pkg/run.py": (
+                "from pkg.steps import step\n"
+                "def runner(state, xs):\n"
+                "    out = step(state, xs)\n"
+                "    return out + state.mean()\n"
+            ),
+        })
+        assert codes(r) == ["JG010"]
+        assert r.active[0].path == "pkg/run.py"
+
+    def test_true_positive_imported_builder(self):
+        # step = make_step() where the builder (and its donate kwargs dict)
+        # live in another module
+        r = analyze_sources({
+            "pkg/build.py": (
+                "import jax\n"
+                "def make_step():\n"
+                "    def body(s, x):\n"
+                "        return s + x\n"
+                "    kwargs = {'donate_argnums': (0,)}\n"
+                "    return jax.jit(body, **kwargs)\n"
+            ),
+            "pkg/run.py": (
+                "from pkg.build import make_step\n"
+                "step = make_step()\n"
+                "def runner(state, xs):\n"
+                "    out = step(state, xs)\n"
+                "    return out + state.mean()\n"
+            ),
+        })
+        assert codes(r) == ["JG010"]
+
+    def test_true_positive_donator_through_package_reexport(self):
+        # `from pkg import step` where pkg/__init__ re-exports it from the
+        # defining module — the realistic import surface of this repo
+        r = analyze_sources({
+            "pkg/__init__.py": "from .steps import step\n",
+            "pkg/steps.py": (
+                "import jax\n"
+                "def _step(s, x):\n"
+                "    return s + x\n"
+                "step = jax.jit(_step, donate_argnums=(0,))\n"
+            ),
+            "app.py": (
+                "from pkg import step\n"
+                "def runner(state, xs):\n"
+                "    out = step(state, xs)\n"
+                "    return out + state.mean()\n"
+            ),
+        })
+        assert codes(r) == ["JG010"]
+        assert r.active[0].path == "app.py"
+
+    def test_true_negative_shifted_position_with_rebind(self):
+        r = run(
+            "import jax\n"
+            "import functools\n"
+            "def g(cfg, s, x):\n"
+            "    return s + x\n"
+            "step = jax.jit(g, donate_argnums=(1,))\n"
+            "def runner(cfg, state, xs):\n"
+            "    p = functools.partial(step, cfg)\n"
+            "    for x in xs:\n"
+            "        state = p(state, x)\n"
+            "    return state\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_imported_donator_with_rebind(self):
+        r = analyze_sources({
+            "pkg/steps.py": (
+                "import jax\n"
+                "def _step(s, x):\n"
+                "    return s + x\n"
+                "step = jax.jit(_step, donate_argnums=(0,))\n"
+            ),
+            "pkg/run.py": (
+                "from pkg.steps import step\n"
+                "def runner(state, xs):\n"
+                "    for x in xs:\n"
+                "        state = step(state, x)\n"
+                "    return state\n"
+            ),
+        })
+        assert codes(r) == []
+
+    def test_partial_alias_is_scoped_to_its_function(self):
+        # a() builds a shifted partial named `p`; b() has its OWN unrelated
+        # local `p` — b must not inherit a()'s donation alias by name
+        r = run(
+            "import jax\n"
+            "import functools\n"
+            "def g(cfg, s, x):\n"
+            "    return s + x\n"
+            "step = jax.jit(g, donate_argnums=(1,))\n"
+            "def a(cfg, state, xs):\n"
+            "    p = functools.partial(step, cfg)\n"
+            "    for x in xs:\n"
+            "        state = p(state, x)\n"
+            "    return state\n"
+            "def b(state):\n"
+            "    p = lambda s: s\n"
+            "    out = p(state)\n"
+            "    return out + state.mean()\n"
+        )
+        assert codes(r) == []
+
+    def test_module_level_partial_alias_is_visible_in_functions(self):
+        r = run(
+            "import jax\n"
+            "import functools\n"
+            "def g(cfg, s, x):\n"
+            "    return s + x\n"
+            "step = jax.jit(g, donate_argnums=(1,))\n"
+            "CFG = object()\n"
+            "p = functools.partial(step, CFG)\n"
+            "def runner(state, xs):\n"
+            "    out = p(state, xs)\n"
+            "    return out + state.mean()\n"
+        )
+        assert codes(r) == ["JG010"]
+
+    def test_partial_of_non_donator_is_ignored(self):
+        r = run(
+            "import functools\n"
+            "def g(s, x):\n"
+            "    return s + x\n"
+            "def runner(state, xs):\n"
+            "    p = functools.partial(g, state)\n"
+            "    return [p(x) for x in xs] + [state.mean()]\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG011 — statically-visible pmap/vmap axis mismatch
+# ===========================================================================
+
+class TestAxisSizeMismatch:
+    def test_true_positive_in_axes_vs_cross_module_arity(self):
+        r = analyze_sources({
+            "pkg/ops.py": (
+                "def loss(params, batch, labels):\n"
+                "    return ((params - batch) ** 2).sum() + labels.sum()\n"
+            ),
+            "pkg/run.py": (
+                "import jax\n"
+                "from pkg.ops import loss\n"
+                "g = jax.vmap(loss, in_axes=(None, 0))\n"
+            ),
+        })
+        assert codes(r) == ["JG011"]
+        assert "pkg.ops.loss" in r.active[0].message
+
+    def test_true_positive_in_axes_vs_call_site(self):
+        r = run(
+            "import jax\n"
+            "def f(x, y):\n"
+            "    return x + y\n"
+            "def runner(x):\n"
+            "    return jax.vmap(f, in_axes=(0, 0))(x)\n"
+        )
+        assert codes(r) == ["JG011"]
+
+    def test_true_positive_literal_shape_mismatch(self):
+        r = run(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def f(x, y):\n"
+            "    return x + y\n"
+            "def runner():\n"
+            "    x = jnp.zeros((4, 3))\n"
+            "    y = jnp.ones((5, 3))\n"
+            "    return jax.vmap(f)(x, y)\n"
+        )
+        assert codes(r) == ["JG011"]
+        assert "size 4" in r.active[0].message
+        assert "size 5" in r.active[0].message
+
+    def test_true_negative_matching_shapes_and_axes(self):
+        r = run(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def f(x, y):\n"
+            "    return x + y\n"
+            "def runner():\n"
+            "    x = jnp.zeros((4, 3))\n"
+            "    y = jnp.ones((4, 3))\n"
+            "    return jax.vmap(f, in_axes=(0, 0))(x, y)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_none_axis_broadcasts(self):
+        r = run(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def f(x, y):\n"
+            "    return x + y\n"
+            "def runner():\n"
+            "    x = jnp.zeros((4, 3))\n"
+            "    y = jnp.ones((5, 3))\n"
+            "    return jax.vmap(f, in_axes=(0, None))(x, y)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_unknown_shapes_are_silence(self):
+        r = run(
+            "import jax\n"
+            "def f(x, y):\n"
+            "    return x + y\n"
+            "def runner(x, y):\n"
+            "    return jax.vmap(f)(x, y)\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# the project index (phase 1)
+# ===========================================================================
+
+class TestProjectIndex:
+    def test_module_names_from_paths(self):
+        from gan_deeplearning4j_tpu.analysis.project import module_name_for_path
+
+        assert module_name_for_path("pkg/sub/mod.py") == "pkg.sub.mod"
+        assert module_name_for_path("pkg/sub/__init__.py") == "pkg.sub"
+        assert module_name_for_path("bench.py") == "bench"
+
+    def test_summaries_record_tracing_donation_and_prng_params(self):
+        from gan_deeplearning4j_tpu.analysis import engine
+        from gan_deeplearning4j_tpu.analysis.project import build_index
+
+        mod = engine.parse_module(
+            "import jax\n"
+            "import functools\n"
+            "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+            "def step(state, batch, rng):\n"
+            "    return state + batch\n",
+            "pkg/steps.py",
+        )
+        idx = build_index([mod])
+        s = idx.lookup("pkg.steps.step")
+        assert s.traced and s.donates == (0,)
+        assert s.prng_params == ("rng",)
+        assert s.params == ("state", "batch", "rng")
+
+    def test_relative_imports_absolutize(self):
+        from gan_deeplearning4j_tpu.analysis import engine
+        from gan_deeplearning4j_tpu.analysis.project import build_index
+
+        pkg_init = engine.parse_module(
+            "from .steps import step\n", "pkg/__init__.py")
+        steps = engine.parse_module(
+            "def step(s):\n    return s\n", "pkg/steps.py")
+        idx = build_index([pkg_init, steps])
+        assert idx.modules["pkg"].imports["step"] == "pkg.steps.step"
+        # one re-export hop: `from pkg import step` resolves to pkg.steps.step
+        user = engine.parse_module("from pkg import step\n", "app.py")
+        idx2 = build_index([pkg_init, steps, user])
+        s = idx2.resolve_function(user, "step")
+        assert s is not None and s.fq == "pkg.steps.step"
+
+
+# ===========================================================================
 # engine mechanics: suppression, baseline, fingerprints, CLI
 # ===========================================================================
 
@@ -517,6 +1060,62 @@ class TestSuppression:
         )
         assert codes(r) == ["JG001"]
 
+    def test_multiple_codes_on_one_line(self):
+        # one line can violate two rules; one comment may name both
+        src = (
+            "import jax\n"
+            "def f(key, b):\n"
+            "    a = jax.random.uniform(key, (b,))\n"
+            "    assert jax.random.uniform(key, (b,)).size  # jaxlint: disable=JG001,JG003\n"
+            "    return a\n"
+        )
+        r = run(src)
+        assert codes(r) == []
+        assert sorted(f.code for f in r.suppressed) == ["JG001", "JG003"]
+        # naming only one of the two leaves the other active
+        r2 = run(src.replace("disable=JG001,JG003", "disable=JG001"))
+        assert codes(r2) == ["JG003"]
+
+    def test_all_wildcard_covers_multiple_codes(self):
+        r = run(
+            "import jax\n"
+            "def f(key, b):\n"
+            "    a = jax.random.uniform(key, (b,))\n"
+            "    assert jax.random.uniform(key, (b,)).size  # jaxlint: disable=all\n"
+            "    return a\n"
+        )
+        assert codes(r) == []
+        assert len(r.suppressed) == 2
+
+    def test_suppression_on_backslash_continuation(self):
+        # the comment can only live on the LAST physical line of a
+        # backslash-continued statement (comments after `\` are illegal);
+        # the span rule must still honor it
+        r = run(
+            "import jax\n"
+            "def f(key, b):\n"
+            "    a = jax.random.uniform(key, (b,))\n"
+            "    c = jax.random.\\\n"
+            "        uniform(key, (b,))  # jaxlint: disable=JG001\n"
+            "    return a, c\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG001"]
+
+    def test_unknown_rule_code_warns_not_silent(self):
+        # a typo'd suppression must not pass silently: the finding stays
+        # active AND the engine reports the bogus code
+        r = run(SUPPRESSED_SRC.replace("disable=JG001", "disable=JG101"))
+        assert codes(r) == ["JG001"]
+        assert len(r.warnings) == 1
+        assert "JG101" in r.warnings[0] and "unknown rule code" in r.warnings[0]
+
+    def test_known_codes_and_all_do_not_warn(self):
+        assert run(SUPPRESSED_SRC).warnings == []
+        assert run(
+            SUPPRESSED_SRC.replace("disable=JG001", "disable=all")
+        ).warnings == []
+
 
 class TestBaseline:
     TP = TestBareAssert  # convenience
@@ -538,6 +1137,59 @@ class TestBaseline:
                 baseline=baseline)
         assert r.active == []
         assert len(r.stale_baseline) == 1
+
+    def test_out_of_scope_entries_are_not_stale(self):
+        """A scoped run (--changed-only, path subset, --rules) must not call
+        entries stale when their file was not analyzed or their rule did not
+        run — and --prune-baseline must not delete them."""
+        src = "def f(x):\n    return x\n"
+        other_file = [{"fingerprint": "deadbeefdeadbeef", "rule": "JG003",
+                       "path": "elsewhere/prod.py",
+                       "justification": "lives in a file this run skipped"}]
+        r = run(src, path="fx/prod.py", baseline=other_file)
+        assert r.stale_baseline == [] and r.gate_ok
+        from gan_deeplearning4j_tpu.analysis.rules import RULES_BY_CODE
+
+        other_rule = [{"fingerprint": "deadbeefdeadbeef", "rule": "JG001",
+                       "path": "fx/prod.py",
+                       "justification": "its rule is filtered out"}]
+        r2 = run(src, path="fx/prod.py", baseline=other_rule,
+                 rules=[RULES_BY_CODE["JG003"]])
+        assert r2.stale_baseline == [] and r2.gate_ok
+        # same path, rule DID run, fingerprint unmatched -> genuinely stale
+        r3 = run(src, path="fx/prod.py", baseline=other_rule)
+        assert len(r3.stale_baseline) == 1 and not r3.gate_ok
+
+    def test_changed_files_from_repo_subdirectory(self, tmp_path):
+        """Modified tracked files must be seen when the analyzer runs from a
+        subdirectory (git diff emits toplevel-relative paths, ls-files
+        cwd-relative ones — the subdir run must normalize both)."""
+        import shutil
+
+        from gan_deeplearning4j_tpu.analysis import changed_files
+
+        if shutil.which("git") is None:  # pragma: no cover
+            pytest.skip("no git in container")
+        env = {**os.environ,
+               "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+        subprocess.run(["git", "-C", str(tmp_path), "init", "-q"],
+                       check=True, env=env)
+        sub = tmp_path / "pkg"
+        sub.mkdir()
+        tracked = sub / "mod.py"
+        tracked.write_text("def f(x):\n    return x\n")
+        subprocess.run(["git", "-C", str(tmp_path), "add", "-A"],
+                       check=True, env=env)
+        subprocess.run(["git", "-C", str(tmp_path), "commit", "-qm", "seed"],
+                       check=True, capture_output=True, env=env)
+        tracked.write_text("def f(x):\n    return x + 1\n")
+        (sub / "new.py").write_text("def g():\n    return 1\n")
+        got = changed_files(root=str(sub))
+        assert got == ["mod.py", "new.py"]
+        # from the toplevel the same files appear with their prefix
+        assert changed_files(root=str(tmp_path)) == [
+            "pkg/mod.py", "pkg/new.py"]
 
     def test_fingerprint_survives_line_drift_but_not_edits(self):
         src = "def f(x):\n    assert x\n"
@@ -567,6 +1219,110 @@ class TestParseErrors:
     def test_unparseable_file_is_a_finding_not_a_crash(self):
         r = run("def broken(:\n")
         assert codes(r) == ["JG000"]
+
+
+# ===========================================================================
+# autofix: --fix rewrites, --fix-suppress insertion, idempotency
+# ===========================================================================
+
+class TestAutofix:
+    DIRTY = (
+        "import jax.numpy as jnp\n"
+        "MAX = 10\n"
+        "def emit(line, x, i, v):\n"
+        "    assert len(line) < MAX, 'oversize'\n"
+        "    x.at[i].set(v)\n"
+        "    return x\n"
+    )
+
+    def _fix(self, tmp_path, src, suppress=False, justification=None):
+        from gan_deeplearning4j_tpu.analysis import fix as fix_mod
+
+        p = tmp_path / "prod.py"
+        p.write_text(src)
+        report = analyze_paths([str(p)], baseline=None, root=str(tmp_path))
+        result = fix_mod.apply_fixes(
+            report, root=str(tmp_path), suppress=suppress,
+            justification=justification,
+        )
+        return p, result
+
+    def test_fix_rewrites_assert_and_at_update(self, tmp_path):
+        p, result = self._fix(tmp_path, self.DIRTY)
+        assert result.rewritten == 2 and result.suppressed == 0
+        fixed = p.read_text()
+        assert "assert" not in fixed
+        assert "raise AssertionError('oversize')" in fixed
+        assert "x = x.at[i].set(v)" in fixed
+        # the rewritten file is clean AND semantically parseable
+        import ast as _ast
+        _ast.parse(fixed)
+        assert analyze_paths([str(p)], root=str(tmp_path)).active == []
+
+    def test_fix_is_idempotent(self, tmp_path):
+        from gan_deeplearning4j_tpu.analysis import fix as fix_mod
+
+        p, _ = self._fix(tmp_path, self.DIRTY)
+        once = p.read_text()
+        report = analyze_paths([str(p)], root=str(tmp_path))
+        result = fix_mod.apply_fixes(report, root=str(tmp_path))
+        assert result.rewritten == 0 and result.files == []
+        assert p.read_text() == once
+
+    def test_fix_skips_non_starting_statements(self, tmp_path):
+        # `if x: assert y` cannot be mechanically rewritten in place
+        p, result = self._fix(tmp_path,
+                              "def f(x, y):\n"
+                              "    if x: assert y\n"
+                              "    return x\n")
+        assert result.rewritten == 0
+        assert len(result.skipped) == 1 and "JG003" in result.skipped[0]
+        assert "assert y" in p.read_text()
+
+    def test_fix_suppress_requires_justification(self, tmp_path):
+        from gan_deeplearning4j_tpu.analysis import fix as fix_mod
+
+        with pytest.raises(ValueError, match="justification"):
+            fix_mod.apply_fixes(
+                analyze_source("def f(x):\n    assert x\n", "p.py"),
+                suppress=True,
+            )
+
+    def test_fix_suppress_inserts_and_is_idempotent(self, tmp_path):
+        p, result = self._fix(
+            tmp_path, self.DIRTY, suppress=True,
+            justification="fixture exercises the hazard on purpose",
+        )
+        assert result.suppressed == 2
+        text = p.read_text()
+        assert text.count("jaxlint: disable=") == 2
+        assert "-- fixture exercises the hazard on purpose" in text
+        report = analyze_paths([str(p)], root=str(tmp_path))
+        assert report.active == [] and len(report.suppressed) == 2
+        # second pass: nothing left to suppress, file unchanged
+        from gan_deeplearning4j_tpu.analysis import fix as fix_mod
+
+        again = fix_mod.apply_fixes(
+            report, root=str(tmp_path), suppress=True, justification="again")
+        assert again.suppressed == 0
+        assert p.read_text() == text
+
+    def test_fix_suppress_lands_after_backslash_continuation(self, tmp_path):
+        p, result = self._fix(
+            tmp_path,
+            "import jax\n"
+            "def f(key, b):\n"
+            "    a = jax.random.uniform(key, (b,))\n"
+            "    c = jax.random.\\\n"
+            "        uniform(key, (b,))\n"
+            "    return a, c\n",
+            suppress=True, justification="test fixture",
+        )
+        assert result.suppressed == 1
+        lines = p.read_text().splitlines()
+        assert lines[3].rstrip().endswith("\\")  # untouched continuation
+        assert "jaxlint: disable=JG001" in lines[4]
+        assert analyze_paths([str(p)], root=str(tmp_path)).active == []
 
 
 class TestCli:
@@ -610,6 +1366,101 @@ class TestCli:
         assert proc.returncode == 2
         assert "neither a directory nor an existing .py file" in proc.stderr
 
+    def test_sarif_format(self, tmp_path):
+        p = tmp_path / "dirty.py"
+        p.write_text("def f(x):\n    assert x\n    return x\n")
+        proc = self._cli(str(p), "--no-baseline", "--format", "sarif")
+        assert proc.returncode == 1
+        data = json.loads(proc.stdout)
+        assert data["version"] == "2.1.0"
+        run0 = data["runs"][0]
+        assert run0["tool"]["driver"]["name"] == "jaxlint"
+        assert {r["id"] for r in run0["tool"]["driver"]["rules"]} == {
+            r.code for r in RULES}
+        (res,) = run0["results"]
+        assert res["ruleId"] == "JG003" and res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] == 2
+        assert res["partialFingerprints"]["jaxlint/v1"]
+
+    def test_stale_baseline_entry_fails_the_gate(self, tmp_path):
+        p = tmp_path / "clean.py"
+        p.write_text("def f(x):\n    return x\n")
+        bl = tmp_path / "bl.json"
+        # no path metadata -> conservatively in-scope for any run
+        bl.write_text(json.dumps({"entries": [
+            {"fingerprint": "deadbeefdeadbeef", "rule": "JG003",
+             "justification": "fixed long ago"}
+        ]}))
+        proc = self._cli(str(p), "--baseline", str(bl))
+        assert proc.returncode == 1
+        assert "stale baseline entry" in proc.stdout
+
+    def test_prune_baseline_drops_stale_and_clears_the_gate(self, tmp_path):
+        p = tmp_path / "clean.py"
+        p.write_text("def f(x):\n    return x\n")
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({"entries": [
+            {"fingerprint": "deadbeefdeadbeef", "rule": "JG003",
+             "justification": "fixed long ago"}
+        ]}))
+        proc = self._cli(str(p), "--baseline", str(bl), "--prune-baseline")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "pruned 1 stale baseline entry" in proc.stderr
+        assert json.loads(bl.read_text())["entries"] == []
+        # gate is green afterwards without the flag
+        proc2 = self._cli(str(p), "--baseline", str(bl))
+        assert proc2.returncode == 0
+
+    def test_fix_suppress_without_justification_is_a_usage_error(self, tmp_path):
+        p = tmp_path / "dirty.py"
+        p.write_text("def f(x):\n    assert x\n")
+        proc = self._cli(str(p), "--no-baseline", "--fix-suppress")
+        assert proc.returncode == 2
+        assert "justification" in proc.stderr
+
+    def test_changed_only_in_a_git_repo(self, tmp_path):
+        import shutil
+
+        if shutil.which("git") is None:  # pragma: no cover
+            pytest.skip("no git in container")
+        env = {**os.environ,
+               "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+
+        def git(*args):
+            subprocess.run(["git", "-C", str(tmp_path), *args],
+                           check=True, capture_output=True, env=env)
+
+        git("init", "-q")
+        committed = tmp_path / "committed.py"
+        committed.write_text("def f(x):\n    assert x\n    return x\n")
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        # untracked dirty file + committed dirty file: --changed-only must
+        # see ONLY the untracked one
+        fresh = tmp_path / "fresh.py"
+        fresh.write_text("def g(x):\n    assert x\n    return x\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "gan_deeplearning4j_tpu.analysis",
+             ".", "--no-baseline", "--changed-only"],
+            capture_output=True, text=True, cwd=str(tmp_path),
+            env={**env, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 1
+        assert "fresh.py" in proc.stdout
+        assert "committed.py" not in proc.stdout
+        # with no changes at all: clean exit, explicit notice
+        fresh.unlink()
+        proc2 = subprocess.run(
+            [sys.executable, "-m", "gan_deeplearning4j_tpu.analysis",
+             ".", "--no-baseline", "--changed-only"],
+            capture_output=True, text=True, cwd=str(tmp_path),
+            env={**env, "PYTHONPATH": REPO},
+        )
+        assert proc2.returncode == 0
+        assert "no changed .py files" in proc2.stderr
+
 
 # ===========================================================================
 # the tier-1 gate: the tree this repo ships is clean
@@ -626,6 +1477,17 @@ class TestTreeIsClean:
         assert rep.active == [], "\n" + "\n".join(
             f.render() for f in rep.active)
         assert rep.stale_baseline == [], rep.stale_baseline
+
+    def test_analyzer_package_is_clean_by_itself(self):
+        """The tier-1 SELF-check: the analyzer analyzes its own package.
+        jaxlint's own code is non-test production Python — it must hold the
+        standards it enforces (and this catches a rule crashing on the
+        analyzer's own idioms, which the whole-tree gate would attribute
+        elsewhere)."""
+        rep = analyze_paths(["gan_deeplearning4j_tpu/analysis"],
+                            baseline=load_baseline(), root=REPO)
+        assert rep.active == [], "\n" + "\n".join(
+            f.render() for f in rep.active)
 
     def test_rules_all_have_fixture_coverage(self):
         # every registered rule code appears in a TP fixture test above —
