@@ -23,6 +23,7 @@ from gan_deeplearning4j_tpu.analysis.rules.scan_dtype import ScanCarryDtypeDrift
 from gan_deeplearning4j_tpu.analysis.rules.callbacks import CallbackInTimedRegion
 from gan_deeplearning4j_tpu.analysis.rules.donation_flow import DonationFlow
 from gan_deeplearning4j_tpu.analysis.rules.axes import AxisSizeMismatch
+from gan_deeplearning4j_tpu.analysis.rules.sharding import DeadDonatedOutSharding
 
 RULES = [
     PrngKeyReuse(),
@@ -36,6 +37,7 @@ RULES = [
     CallbackInTimedRegion(),
     DonationFlow(),
     AxisSizeMismatch(),
+    DeadDonatedOutSharding(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,143 @@
+"""JG012 — dead ``out_shardings`` on donated buffers.
+
+Donation lets XLA alias an input buffer into an output — but ONLY when an
+output exists with the same sharding (and shape) as the donated input. A
+``jax.jit`` call that donates an argument while declaring ``out_shardings``
+in which the donated input's sharding never appears has quietly disabled
+the donation: XLA frees the input, reshards into a fresh allocation, and
+the HBM saving the donation was written for is gone. Nothing fails — jax
+at most logs a "donated buffer was not usable" warning that scrolls past —
+so peak memory is silently ~2× what the code claims. This is the
+production flavor of the hazard: the sharding ladder gets edited (an
+output resharded to ``data`` for a downstream consumer) and the donation
+on the companion input becomes dead weight.
+
+The rule fires when a jit/pmap call has statically-resolvable
+``donate_argnums``, ``in_shardings`` AND ``out_shardings`` (literal tuples
+— including the ``(rep,) * 4 + (data,) * 4`` repetition idiom and the
+``kwargs``-dict builder idiom of ``harness/experiment.py``) and some
+donated position's in-sharding expression matches NO out-sharding
+expression. Comparison is syntactic (unparsed expression text): two
+spellings of the same sharding are accepted imprecision on the safe side
+(no finding), and unresolvable specs are skipped entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from gan_deeplearning4j_tpu.analysis import _common
+from gan_deeplearning4j_tpu.analysis.project import jit_donate_argnums
+
+
+def _elems(node: ast.AST) -> Optional[Tuple[str, List[str]]]:
+    """Static element list of a shardings spec expression.
+
+    Returns ``("tuple", [unparsed element, ...])`` for tuple-shaped specs —
+    literal tuples, ``(x,) * k`` repetition, and ``+`` concatenation — or
+    ``("single", [unparsed])`` for a lone sharding jit broadcasts to every
+    leaf; None when the shape cannot be resolved statically."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return "tuple", [ast.unparse(e) for e in node.elts]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left, right = _elems(node.left), _elems(node.right)
+        if left and right and left[0] == right[0] == "tuple":
+            return "tuple", left[1] + right[1]
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        base, count = node.left, node.right
+        if isinstance(base, ast.Constant):
+            base, count = count, base  # 4 * (rep,)
+        inner = _elems(base)
+        if (inner and inner[0] == "tuple"
+                and isinstance(count, ast.Constant)
+                and isinstance(count.value, int)):
+            return "tuple", inner[1] * count.value
+        return None
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Call)):
+        return "single", [ast.unparse(node)]
+    return None
+
+
+def _spec_node(call: ast.Call, scope_body, key: str) -> Optional[ast.AST]:
+    """The expression bound to ``key`` for this jit call: a direct kwarg,
+    a ``**kwargs`` dict-literal entry, or a single ``kwargs[key] = ...``
+    subscript assignment in the same scope (the conditional-sharding
+    builder idiom). Ambiguous (multiply-assigned) keys resolve to None."""
+    for kw in call.keywords:
+        if kw.arg == key:
+            return kw.value
+    for kw in call.keywords:
+        if kw.arg is None and isinstance(kw.value, ast.Name) and scope_body:
+            kwname = kw.value.id
+            found: List[ast.AST] = []
+            for stmt in scope_body:
+                for n in ast.walk(stmt):
+                    if (isinstance(n, ast.Assign) and len(n.targets) == 1):
+                        t = n.targets[0]
+                        if (isinstance(t, ast.Name) and t.id == kwname
+                                and isinstance(n.value, ast.Dict)):
+                            for k, v in zip(n.value.keys, n.value.values):
+                                if (isinstance(k, ast.Constant)
+                                        and k.value == key):
+                                    found.append(v)
+                        elif (isinstance(t, ast.Subscript)
+                              and isinstance(t.value, ast.Name)
+                              and t.value.id == kwname
+                              and isinstance(t.slice, ast.Constant)
+                              and t.slice.value == key):
+                            found.append(n.value)
+            if len(found) == 1:
+                return found[0]
+            return None
+    return None
+
+
+class DeadDonatedOutSharding:
+    code = "JG012"
+    name = "dead-donated-out-sharding"
+    summary = "out_shardings never matches a donated input — donation is dead"
+
+    def check(self, mod):
+        # scopes nest (the module walk revisits function bodies with the
+        # wrong body for kwargs resolution) — analyze every scope and let
+        # the engine's (code, path, line, col) dedup keep the first finding
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None) or []
+            for node in ast.walk(scope):
+                if not (isinstance(node, ast.Call)
+                        and mod.resolve(node.func) in _common.JIT_WRAPPERS):
+                    continue
+                nums = jit_donate_argnums(node, body, mod.resolve)
+                if not nums:
+                    continue
+                in_spec = _spec_node(node, body, "in_shardings")
+                out_spec = _spec_node(node, body, "out_shardings")
+                if in_spec is None or out_spec is None:
+                    continue
+                ins = _elems(in_spec)
+                outs = _elems(out_spec)
+                if ins is None or outs is None:
+                    continue
+                out_set = set(outs[1])
+                for pos in nums:
+                    if ins[0] == "single":
+                        elem = ins[1][0]
+                    elif pos < len(ins[1]):
+                        elem = ins[1][pos]
+                    else:
+                        continue
+                    if elem not in out_set:
+                        yield mod.finding(
+                            self.code,
+                            f"argument {pos} is donated but its in-sharding "
+                            f"`{elem}` matches no entry of out_shardings — "
+                            f"XLA cannot alias the donated buffer into any "
+                            f"output, so the donation is dead (the buffer "
+                            f"is freed and a fresh allocation resharded "
+                            f"into); make an output sharding match or drop "
+                            f"the donation for this argument",
+                            node,
+                        ), node
+                        break

@@ -49,6 +49,11 @@ class ExperimentConfig:
     output_dir: str = "output"
     file_prefix: str = "mnist"
     save_models: bool = True
+    # Checkpoint every k-th iteration (reference cadence: every iteration,
+    # dl4jGANComputerVision.java:605-619 — the default preserves it). Larger
+    # values also re-enable the lax.scan device loop between checkpoint
+    # boundaries, which per-iteration checkpointing forbids.
+    checkpoint_every: int = 1
     resume: bool = False  # restore states from output_dir before training
 
     # -- WGAN-GP (BASELINE.md config 5; ignored by the XENT families) --------
@@ -131,6 +136,8 @@ class ExperimentConfig:
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
         if self.dis_lr_decay_every < 0:
             raise ValueError("dis_lr_decay_every must be >= 0 (0 = off)")
+        if self.checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
         if self.dis_lr_decay_every and not 0.0 < self.dis_lr_decay_rate <= 1.0:
             raise ValueError(
                 f"dis_lr_decay_rate {self.dis_lr_decay_rate} must be in (0, 1]"

@@ -812,6 +812,64 @@ class GanExperiment:
             out.append(path)
         return out
 
+    def publish_for_serving(self, directory: Optional[str] = None) -> Dict:
+        """Publish the trained INFERENCE artifacts — the paper's end product:
+        the generator used only for sampling plus the discriminator-feature
+        classifier (SURVEY §0) — as a serving bundle the ``serving/``
+        subsystem loads without any training code.
+
+        Unlike ``save_models`` this drops updater state (a serving replica
+        never steps an optimizer — shipping RmsProp caches would double the
+        artifact for nothing) and writes a ``serving.json`` manifest naming
+        the checkpoints, the feature vertex for the features endpoint, and
+        the request shapes. Every file lands via write-to-temp + atomic
+        rename (``write_model`` and the manifest both), so a reload loop
+        polling the directory can never observe a truncated artifact."""
+        import json as _json
+        import tempfile as _tempfile
+
+        cfg = self.config
+        directory = directory or os.path.join(cfg.output_dir, "serving")
+        os.makedirs(directory, exist_ok=True)
+        gen_name = f"{cfg.file_prefix}_gen_serving.zip"
+        write_model(
+            os.path.join(directory, gen_name), self.gen, self.gen_params,
+            save_updater=False,
+        )
+        cv_name = None
+        feature_vertex = None
+        if self.cv is not None:
+            cv_name = f"{cfg.file_prefix}_CV_serving.zip"
+            write_model(
+                os.path.join(directory, cv_name), self.cv, self.cv_state,
+                save_updater=False,
+            )
+            # the deepest dis-derived layer — the features the classifier
+            # was transfer-built on (mnist: dis_dense_layer_6)
+            feature_vertex = list(self.family.dis_to_cv.values())[-1]
+        manifest = {
+            "format_version": 1,
+            "family": self.family.name,
+            "generator": gen_name,
+            "classifier": cv_name,
+            "feature_vertex": feature_vertex,
+            "z_size": int(self.model_cfg.z_size),
+            "num_features": int(cfg.num_features),
+            "num_classes": int(cfg.num_classes),
+            "step": int(self.gan_state.step),
+        }
+        fd, tmp = _tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                _json.dump(manifest, fh, indent=2)
+                fh.write("\n")
+            os.replace(tmp, os.path.join(directory, "serving.json"))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return {**manifest, "directory": directory}
+
     def load_models(self, directory: Optional[str] = None) -> int:
         """Resume: restore every state ``save_models`` wrote (params + updater
         + step — the capability the reference's saveUpdater=true format
@@ -858,20 +916,24 @@ class GanExperiment:
         """How many iterations the device loop may run before the host must
         intervene. An export after iteration j needs the state AT j, so an
         export index may only be a window's LAST element; per-iteration
-        checkpointing (save_models) forces windows of 1, as do the phased
-        trainer and loss_fetch_every=1 (label-noise resampling happens
-        inside the scanned body since round 5, so it no longer forces
-        per-dispatch stepping)."""
+        checkpointing (save_models with checkpoint_every=1) forces windows
+        of 1, as do the phased trainer and loss_fetch_every=1 (label-noise
+        resampling happens inside the scanned body since round 5, so it no
+        longer forces per-dispatch stepping). A sparser checkpoint cadence
+        (checkpoint_every > 1) only bounds windows at its own boundaries,
+        like the export cadences."""
         cfg = self.config
         if (
             not getattr(self, "_supports_device_loop", False)  # phased path
-            or cfg.save_models
+            or (cfg.save_models and cfg.checkpoint_every <= 1)
             or cfg.loss_fetch_every <= 1
         ):
             return 1
         i = self.batch_counter
         w = min(cfg.loss_fetch_every, cfg.num_iterations - i)
         bounds = [cfg.print_every]
+        if cfg.save_models:
+            bounds.append(cfg.checkpoint_every)
         if have_predictions:
             bounds.append(cfg.save_every)
         for every in bounds:
@@ -1046,7 +1108,9 @@ class GanExperiment:
                         with self.timer.phase("eval_callback"):
                             eval_callback(self, index)
                         window_t0 = time.perf_counter()
-                    if cfg.save_models:
+                    if cfg.save_models and (
+                        self.batch_counter % cfg.checkpoint_every == 0
+                    ):
                         with self.timer.phase("checkpoint"):
                             self.save_models()
                     logger.info("Completed Batch %d!", self.batch_counter)
@@ -1056,6 +1120,18 @@ class GanExperiment:
                 if not carry and not train_iterator.has_next():
                     train_iterator.reset()  # (:600-602)
         flush()
+        if (
+            cfg.save_models
+            and cfg.checkpoint_every > 1
+            and self.batch_counter > 0
+            and (self.batch_counter - 1) % cfg.checkpoint_every != 0
+        ):
+            # final-state checkpoint: with a sparse cadence the last saved
+            # checkpoint can trail the end of the run by up to
+            # checkpoint_every-1 iterations — resume/publish must see the
+            # weights training actually finished with
+            with self.timer.phase("checkpoint"):
+                self.save_models()
         return {
             "iterations": self.batch_counter,
             "history": history,

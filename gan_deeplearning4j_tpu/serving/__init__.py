@@ -1,0 +1,35 @@
+"""serving/ — batched inference for the trained artifacts (SURVEY §0).
+
+The paper's end product is not the training loop but what it leaves behind:
+a generator used only for sampling and a 10-class classifier built on the
+discriminator's learned features. This package is the deploy surface the
+reference never had — it loads serializer checkpoints and answers three
+request types (sample-from-z, classify-image, extract-discriminator-
+features) through one dynamic micro-batcher:
+
+- :mod:`.engine` — restores ``ComputationGraph``s from checkpoint zips,
+  AOT-compiles one executable per (request kind, padded batch bucket) so
+  arbitrary request sizes never trigger a fresh XLA compile, and pins the
+  weights on device once;
+- :mod:`.batcher` — a queue-based micro-batcher with max-latency / max-batch
+  triggers, per-request deadlines, and backpressure (bounded queue that
+  sheds with an explicit "overloaded" result instead of growing without
+  bound);
+- :mod:`.service` — the in-process API plus a stdlib-only HTTP JSON
+  endpoint with ``/healthz`` and ``/metrics``;
+- ``python -m gan_deeplearning4j_tpu.serving`` — the server CLI.
+
+Architecture notes: docs/SERVING.md.
+"""
+
+from gan_deeplearning4j_tpu.serving.batcher import MicroBatcher, ServeResult
+from gan_deeplearning4j_tpu.serving.engine import ServingEngine
+from gan_deeplearning4j_tpu.serving.service import InferenceService, make_server
+
+__all__ = [
+    "MicroBatcher",
+    "ServeResult",
+    "ServingEngine",
+    "InferenceService",
+    "make_server",
+]

@@ -1,0 +1,271 @@
+"""Dynamic micro-batcher — the serving throughput lever (μ-cuDNN-style).
+
+Single-request dispatch wastes an accelerator: a batch-1 forward pays the
+same dispatch latency as batch-128 for ~1% of the useful work. This module
+coalesces concurrent requests of the same kind into one device batch under
+two triggers — a full batch (``max_batch`` rows) or the oldest request
+aging past ``max_latency`` — the classic throughput/latency trade of
+server-side batching (*TensorFlow: a system for large-scale ML*, §4.3).
+
+Backpressure is explicit, not emergent: the queue is bounded, and a submit
+against a full queue returns an ``overloaded`` result IMMEDIATELY instead
+of blocking or growing the queue without bound — under overload a serving
+tier must shed load in O(1), because every queued request it cannot serve
+within its deadline is work thrown away *after* paying for it. Requests
+that expire while queued are likewise shed with ``deadline`` before any
+device work is spent on them.
+
+Pure stdlib (threading/collections): no jax import, so the batching policy
+is unit-testable with a fake engine and reusable for any ``run_fn``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.utils.profiling import percentiles
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """Outcome of one request. ``status`` is always one of:
+
+    - ``ok``          — ``data`` holds the result rows;
+    - ``overloaded``  — shed at submit time, queue full (backpressure);
+    - ``deadline``    — expired while queued, never ran;
+    - ``error``       — the engine raised; ``error`` holds the message.
+
+    Every submitted request gets exactly one ServeResult — the zero-lost
+    invariant the bench asserts."""
+
+    status: str
+    data: Optional[np.ndarray] = None
+    error: Optional[str] = None
+    latency_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+
+@dataclasses.dataclass
+class _Pending:
+    kind: str
+    rows: np.ndarray
+    deadline: float
+    enqueued: float
+    event: threading.Event
+    result: Optional[ServeResult] = None
+
+    def finish(self, result: ServeResult) -> None:
+        result.latency_s = time.monotonic() - self.enqueued
+        self.result = result
+        self.event.set()
+
+
+class MicroBatcher:
+    """Queue-based micro-batcher over a ``run_fn(kind, rows) -> rows``.
+
+    One worker thread drains a bounded FIFO: it picks the oldest request's
+    kind, coalesces every queued request of that kind (submission order,
+    up to ``max_batch`` rows), and waits out the remainder of
+    ``max_latency`` (measured from the oldest request) for stragglers when
+    the batch is not yet full. ``close()`` drains what is queued, then
+    stops the worker."""
+
+    def __init__(
+        self,
+        run_fn: Callable[[str, np.ndarray], np.ndarray],
+        *,
+        max_batch: int = 128,
+        max_latency: float = 0.005,
+        max_queue: int = 256,
+        default_timeout: float = 5.0,
+        max_samples: int = 65536,
+    ):
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        self._run_fn = run_fn
+        self.max_batch = max_batch
+        self.max_latency = max_latency
+        self.max_queue = max_queue
+        self.default_timeout = default_timeout
+
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._closed = False
+
+        # -- counters (read under the lock; exported by metrics()) ----------
+        self._submitted: Dict[str, int] = defaultdict(int)
+        self._completed: Dict[str, int] = defaultdict(int)
+        self._shed_overloaded = 0
+        self._shed_deadline = 0
+        self._errors = 0
+        self._flushes = 0
+        self._occupancy: Dict[int, int] = defaultdict(int)  # rows/flush -> n
+        self._latencies: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=max_samples)
+        )
+
+        self._worker = threading.Thread(
+            target=self._loop, name="micro-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- client side --------------------------------------------------------
+    def submit(
+        self, kind: str, rows: np.ndarray, timeout: Optional[float] = None
+    ) -> ServeResult:
+        """Block until the request completes or is shed. Bounded wait: the
+        caller is back within ``timeout`` (+ scheduling noise) in EVERY
+        case — full queue, expired deadline, engine error, or success."""
+        timeout = self.default_timeout if timeout is None else timeout
+        rows = np.asarray(rows)
+        if rows.ndim != 2 or rows.shape[0] < 1:
+            return ServeResult("error", error=f"expected (n, d) rows, got {rows.shape}")
+        now = time.monotonic()
+        req = _Pending(
+            kind=kind,
+            rows=rows,
+            deadline=now + timeout,
+            enqueued=now,
+            event=threading.Event(),
+        )
+        with self._lock:
+            self._submitted[kind] += 1
+            if self._closed:
+                self._shed_overloaded += 1
+                return ServeResult("overloaded", error="batcher is closed")
+            if len(self._queue) >= self.max_queue:
+                # backpressure: shed NOW, in O(1) — never queue what cannot
+                # be served, never block the client on a full queue
+                self._shed_overloaded += 1
+                return ServeResult("overloaded", error="queue full")
+            self._queue.append(req)
+            self._nonempty.notify()
+        # the worker sheds expired requests, so this wait is bounded; the
+        # grace covers a flush already in flight at deadline time
+        req.event.wait(timeout + self.max_latency + 1.0)
+        if req.result is None:  # worker wedged (engine hung) — still bounded
+            return ServeResult("deadline", error="no result within deadline")
+        return req.result
+
+    def close(self, drain: bool = True) -> None:
+        with self._lock:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    self._shed_overloaded += 1  # keep the zero-lost ledger
+                    self._queue.popleft().finish(
+                        ServeResult("overloaded", error="batcher is closed")
+                    )
+            self._nonempty.notify()
+        self._worker.join(timeout=10.0)
+
+    # -- worker side --------------------------------------------------------
+    def _take_batch(self):
+        """Under the lock: wait for work, pick the oldest request's kind,
+        and cut a same-kind batch (≤ max_batch rows, submission order)."""
+        while True:
+            while not self._queue and not self._closed:
+                self._nonempty.wait()
+            if not self._queue:
+                return None  # closed and drained
+            oldest = self._queue[0]
+            # not full yet and still young: give stragglers a chance
+            age = time.monotonic() - oldest.enqueued
+            if age < self.max_latency and not self._closed:
+                same = sum(
+                    r.rows.shape[0] for r in self._queue if r.kind == oldest.kind
+                )
+                if same < self.max_batch:
+                    self._nonempty.wait(timeout=self.max_latency - age)
+                    continue
+            batch, keep, total = [], deque(), 0
+            for req in self._queue:
+                if req.kind == oldest.kind and total + req.rows.shape[0] <= self.max_batch:
+                    batch.append(req)
+                    total += req.rows.shape[0]
+                else:
+                    keep.append(req)
+            if not batch:  # oldest alone exceeds max_batch — take it anyway
+                batch.append(oldest)
+                keep = deque(r for r in self._queue if r is not oldest)
+            self._queue = keep
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                batch = self._take_batch()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live = []
+            for req in batch:
+                if now > req.deadline:
+                    with self._lock:
+                        self._shed_deadline += 1
+                    req.finish(
+                        ServeResult("deadline", error="expired while queued")
+                    )
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            try:
+                # the concatenate stays INSIDE the guard: a width-mismatched
+                # rider must error its own batch, not kill the worker thread
+                rows = (
+                    live[0].rows
+                    if len(live) == 1
+                    else np.concatenate([r.rows for r in live])
+                )
+                out = np.asarray(self._run_fn(live[0].kind, rows))
+            except Exception as exc:  # engine failure -> every rider errors
+                with self._lock:
+                    self._errors += len(live)
+                for req in live:
+                    req.finish(ServeResult("error", error=f"{type(exc).__name__}: {exc}"))
+                continue
+            with self._lock:
+                self._flushes += 1
+                self._occupancy[rows.shape[0]] += 1
+            offset = 0
+            for req in live:
+                n = req.rows.shape[0]
+                req.finish(ServeResult("ok", data=out[offset:offset + n]))
+                offset += n
+                with self._lock:
+                    self._completed[req.kind] += 1
+                    self._latencies[req.kind].append(req.result.latency_s)
+
+    # -- observability ------------------------------------------------------
+    def metrics(self) -> dict:
+        """Counter snapshot + latency percentiles + occupancy histogram —
+        the /metrics payload schema (docs/SERVING.md)."""
+        with self._lock:
+            lat = {
+                kind: {
+                    k: v * 1e3 for k, v in percentiles(samples).items()
+                }
+                for kind, samples in self._latencies.items()
+            }
+            return {
+                "submitted": dict(self._submitted),
+                "completed": dict(self._completed),
+                "shed_overloaded": self._shed_overloaded,
+                "shed_deadline": self._shed_deadline,
+                "errors": self._errors,
+                "flushes": self._flushes,
+                "queue_depth": len(self._queue),
+                "batch_occupancy": {str(k): v for k, v in sorted(self._occupancy.items())},
+                "latency_ms": lat,
+            }

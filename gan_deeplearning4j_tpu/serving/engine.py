@@ -1,0 +1,232 @@
+"""ServingEngine — checkpoint-backed executor with a padded bucket ladder.
+
+Loads serializer checkpoints (``utils/serializer.read_model`` — topology +
+params, no training code needed), pins the weights on device ONCE, and
+pre-compiles one XLA executable per (request kind, batch bucket) via jit's
+AOT path (``lower().compile()``). Requests are padded up to the smallest
+bucket and sliced back, so an arbitrary request size NEVER triggers a fresh
+compile at serve time — with free-running shapes every new batch size would
+stall a request tail for seconds of XLA compilation (the recompilation
+hazard jaxlint JG004 polices in training code, recurring here as a serving
+tail-latency cliff). Compiles are counted per kind; the serve bench asserts
+the count stays ≤ the ladder size.
+
+Request kinds (SURVEY §0 — the trained artifacts, not the loop):
+
+- ``sample``:   z (n, z_size)        -> generator images (n, num_features)
+- ``classify``: x (n, num_features)  -> class probabilities (n, num_classes)
+- ``features``: x (n, num_features)  -> discriminator-feature activations
+  at the transfer classifier's feature vertex (mnist: ``dis_dense_layer_6``)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class ServingEngine:
+    """Model-backed executor: ``run(kind, rows) -> rows``.
+
+    ``models`` maps role ("generator"/"classifier") to a loaded
+    ``(ComputationGraph, params)`` pair. Thread-safe: AOT executables are
+    compiled under a lock (the batcher worker is single-threaded, but the
+    in-process API may be driven from many threads)."""
+
+    def __init__(
+        self,
+        models: Dict[str, Tuple[object, dict]],
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        feature_vertex: Optional[str] = None,
+    ):
+        import jax
+
+        if not models:
+            raise ValueError("ServingEngine needs at least one model")
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"invalid bucket ladder {buckets!r}")
+        self.buckets = buckets
+        self.feature_vertex = feature_vertex
+        # weights cross to the device once, here — never per request
+        self._graphs = {role: graph for role, (graph, _) in models.items()}
+        self._params = {
+            role: jax.device_put(params) for role, (_, params) in models.items()
+        }
+
+        self._kinds: Dict[str, Tuple[str, object]] = {}  # kind -> (role, fn)
+        if "generator" in models:
+            gen = self._graphs["generator"]
+            # flatten NHWC image outputs to (n, features): the wire contract
+            # is rows, matching the reference's flat CSV exports
+            self._kinds["sample"] = (
+                "generator",
+                lambda p, z: gen.output(p, z, train=False).reshape(
+                    (z.shape[0], -1)
+                ),
+            )
+        if "classifier" in models:
+            cv = self._graphs["classifier"]
+            self._kinds["classify"] = (
+                "classifier",
+                lambda p, x: cv.output(p, x, train=False),
+            )
+            if feature_vertex is not None:
+                if feature_vertex not in {v.name for v in cv.vertices}:
+                    raise ValueError(
+                        f"feature vertex {feature_vertex!r} is not a vertex of "
+                        f"the classifier graph"
+                    )
+                self._kinds["features"] = (
+                    "classifier",
+                    lambda p, x: cv.feed_forward(p, x, train=False)[feature_vertex],
+                )
+
+        self._in_width = {
+            kind: self._graphs[role].input_types[0].features
+            for kind, (role, _) in self._kinds.items()
+        }
+        self._compiled: Dict[Tuple[str, int], object] = {}
+        self._compile_counts: Dict[str, int] = {k: 0 for k in self._kinds}
+        self._lock = threading.Lock()
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_checkpoints(
+        cls,
+        generator: Optional[str] = None,
+        classifier: Optional[str] = None,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        feature_vertex: Optional[str] = None,
+    ) -> "ServingEngine":
+        """Restore from serializer checkpoint zips. Updater state is never
+        loaded — a serving replica has no optimizer."""
+        from gan_deeplearning4j_tpu.utils.serializer import read_model
+
+        models = {}
+        for role, path in (("generator", generator), ("classifier", classifier)):
+            if path is None:
+                continue
+            graph, params, _, _ = read_model(path, load_updater=False)
+            models[role] = (graph, params)
+        return cls(models, buckets=buckets, feature_vertex=feature_vertex)
+
+    @classmethod
+    def from_bundle(
+        cls, directory: str, *, buckets: Sequence[int] = DEFAULT_BUCKETS
+    ) -> "ServingEngine":
+        """Load a ``serving.json`` bundle published by
+        ``GanExperiment.publish_for_serving``."""
+        with open(os.path.join(directory, "serving.json")) as fh:
+            manifest = json.load(fh)
+        if manifest.get("format_version", 0) > 1:
+            raise ValueError(
+                f"serving bundle format {manifest['format_version']} is newer "
+                f"than supported"
+            )
+
+        def _path(key):
+            name = manifest.get(key)
+            return os.path.join(directory, name) if name else None
+
+        return cls.from_checkpoints(
+            generator=_path("generator"),
+            classifier=_path("classifier"),
+            buckets=buckets,
+            feature_vertex=manifest.get("feature_vertex"),
+        )
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def kinds(self) -> Tuple[str, ...]:
+        return tuple(self._kinds)
+
+    def input_width(self, kind: str) -> int:
+        return self._in_width[kind]
+
+    @property
+    def compile_counts(self) -> Dict[str, int]:
+        """Distinct XLA compiles per kind so far — the bench's ladder
+        invariant (each must stay ≤ ``len(self.buckets)``)."""
+        with self._lock:
+            return dict(self._compile_counts)
+
+    # -- compilation --------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _executable(self, kind: str, bucket: int):
+        key = (kind, bucket)
+        exe = self._compiled.get(key)
+        if exe is not None:
+            return exe
+        with self._lock:
+            exe = self._compiled.get(key)
+            if exe is not None:
+                return exe
+            import jax
+
+            role, fn = self._kinds[kind]
+            spec = jax.ShapeDtypeStruct(
+                (bucket, self._in_width[kind]), np.float32
+            )
+            # AOT: lower for the exact padded shape and keep the executable;
+            # serve-time calls can then never re-trace or re-compile
+            exe = jax.jit(fn).lower(self._params[role], spec).compile()
+            self._compiled[key] = exe
+            self._compile_counts[kind] += 1
+            return exe
+
+    def warmup(self) -> Dict[str, int]:
+        """Compile the FULL ladder up front (cold-start cost paid before the
+        first request, not by it). Returns the per-kind compile counts."""
+        for kind in self._kinds:
+            for b in self.buckets:
+                self._executable(kind, b)
+        return self.compile_counts
+
+    # -- execution ----------------------------------------------------------
+    def run(self, kind: str, rows: np.ndarray) -> np.ndarray:
+        """Execute one batch: pad to the bucket, run the AOT executable,
+        slice the padding back off. Batches larger than the top bucket are
+        served in top-bucket chunks (the batcher's max_batch normally
+        prevents that, but the engine stays correct standalone)."""
+        if kind not in self._kinds:
+            raise KeyError(
+                f"unknown request kind {kind!r}; serving {sorted(self._kinds)}"
+            )
+        rows = np.asarray(rows, dtype=np.float32)
+        if (rows.ndim != 2 or rows.shape[0] < 1
+                or rows.shape[1] != self._in_width[kind]):
+            raise ValueError(
+                f"{kind}: expected (n >= 1, {self._in_width[kind]}) rows, "
+                f"got {rows.shape}"
+            )
+        role, _ = self._kinds[kind]
+        params = self._params[role]
+        top = self.buckets[-1]
+        outs = []
+        for start in range(0, rows.shape[0], top):
+            chunk = rows[start:start + top]
+            bucket = self._bucket_for(chunk.shape[0])
+            if chunk.shape[0] < bucket:
+                pad = np.zeros(
+                    (bucket - chunk.shape[0], chunk.shape[1]), np.float32
+                )
+                chunk = np.concatenate([chunk, pad])
+            out = self._executable(kind, bucket)(params, chunk)
+            outs.append(
+                np.asarray(out)[: min(top, rows.shape[0] - start)]
+            )
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)

@@ -1,0 +1,194 @@
+"""InferenceService — the request surface over engine + micro-batcher.
+
+Two front ends share one code path (``handle``): the in-process Python API
+(what tests and the bench drive — no sockets, same batching semantics) and
+a stdlib-only HTTP JSON endpoint (``http.server.ThreadingHTTPServer`` — no
+framework dependency, per the repo's no-new-deps rule). Endpoints:
+
+- ``POST /v1/sample``    {"data": [[z...], ...]}  -> {"status","data"}
+- ``POST /v1/classify``  {"data": [[x...], ...]}  -> {"status","data"}
+- ``POST /v1/features``  {"data": [[x...], ...]}  -> {"status","data"}
+- ``GET  /healthz``      liveness + loaded kinds
+- ``GET  /metrics``      request counters, p50/p95/p99 latency, batch-
+  occupancy histogram, shed counts, per-kind compile counts
+
+Shed responses map to HTTP 503 (overloaded / deadline) so load balancers
+can react; engine errors map to 500, bad requests to 400.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.serving.batcher import MicroBatcher, ServeResult
+from gan_deeplearning4j_tpu.serving.engine import ServingEngine
+
+logger = logging.getLogger(__name__)
+
+_STATUS_HTTP = {"ok": 200, "overloaded": 503, "deadline": 503, "error": 500}
+
+
+class InferenceService:
+    """The in-process serving API. One micro-batcher fronts the engine;
+    every public call goes through it, so in-process and HTTP callers share
+    batching, deadlines, and backpressure."""
+
+    def __init__(
+        self,
+        engine: ServingEngine,
+        *,
+        max_batch: Optional[int] = None,
+        max_latency: float = 0.005,
+        max_queue: int = 256,
+        default_timeout: float = 5.0,
+        warmup: bool = True,
+    ):
+        self.engine = engine
+        if warmup:
+            engine.warmup()
+        self.batcher = MicroBatcher(
+            engine.run,
+            max_batch=max_batch or engine.buckets[-1],
+            max_latency=max_latency,
+            max_queue=max_queue,
+            default_timeout=default_timeout,
+        )
+
+    # -- typed convenience wrappers ----------------------------------------
+    def sample(self, z, timeout: Optional[float] = None) -> ServeResult:
+        return self.batcher.submit("sample", z, timeout=timeout)
+
+    def classify(self, x, timeout: Optional[float] = None) -> ServeResult:
+        return self.batcher.submit("classify", x, timeout=timeout)
+
+    def features(self, x, timeout: Optional[float] = None) -> ServeResult:
+        return self.batcher.submit("features", x, timeout=timeout)
+
+    # -- shared request handler --------------------------------------------
+    def healthz(self) -> dict:
+        return {"status": "ok", "kinds": list(self.engine.kinds),
+                "buckets": list(self.engine.buckets)}
+
+    def metrics(self) -> dict:
+        return {
+            **self.batcher.metrics(),
+            "compile_counts": self.engine.compile_counts,
+        }
+
+    def handle(self, method: str, path: str, payload: Optional[dict] = None
+               ) -> Tuple[int, dict]:
+        """(http_status, response_body) for one request — the single routing
+        table both front ends use."""
+        if method == "GET" and path == "/healthz":
+            return 200, self.healthz()
+        if method == "GET" and path == "/metrics":
+            return 200, self.metrics()
+        if method == "POST" and path.startswith("/v1/"):
+            kind = path[len("/v1/"):]
+            if kind not in self.engine.kinds:
+                return 404, {"status": "error",
+                             "error": f"unknown request kind {kind!r}"}
+            data = (payload or {}).get("data")
+            if data is None:
+                return 400, {"status": "error", "error": "missing 'data'"}
+            try:
+                rows = np.asarray(data, dtype=np.float32)
+            except (TypeError, ValueError) as exc:
+                return 400, {"status": "error", "error": f"bad 'data': {exc}"}
+            if rows.ndim == 1:
+                rows = rows[None, :]
+            width = self.engine.input_width(kind)
+            # reject malformed shapes HERE: a bad row must 400 its own
+            # request, never reach the shared batch and error its riders
+            if rows.ndim != 2 or rows.shape[0] < 1 or rows.shape[1] != width:
+                return 400, {
+                    "status": "error",
+                    "error": f"{kind}: expected (n >= 1, {width}) rows, "
+                             f"got {tuple(rows.shape)}",
+                }
+            timeout = (payload or {}).get("timeout")
+            if timeout is not None:
+                try:
+                    timeout = float(timeout)
+                except (TypeError, ValueError):
+                    return 400, {"status": "error",
+                                 "error": f"bad 'timeout': {timeout!r}"}
+            result = self.batcher.submit(kind, rows, timeout=timeout)
+            body = {"status": result.status,
+                    "latency_ms": result.latency_s * 1e3}
+            if result.ok:
+                body["data"] = np.asarray(result.data).tolist()
+            elif result.error:
+                body["error"] = result.error
+            return _STATUS_HTTP.get(result.status, 500), body
+        return 404, {"status": "error", "error": f"no route {method} {path}"}
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+# -- HTTP front end ---------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    service: InferenceService = None  # bound by make_server
+
+    def _respond(self, status: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (http.server naming contract)
+        try:
+            status, body = self.service.handle("GET", self.path)
+        except Exception as exc:  # a handler bug must answer 500, not reset
+            logger.exception("GET %s failed", self.path)
+            status, body = 500, {"status": "error",
+                                 "error": f"{type(exc).__name__}: {exc}"}
+        self._respond(status, body)
+
+    def do_POST(self):  # noqa: N802
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as exc:
+            self._respond(400, {"status": "error", "error": f"bad JSON: {exc}"})
+            return
+        try:
+            status, body = self.service.handle("POST", self.path, payload)
+        except Exception as exc:
+            logger.exception("POST %s failed", self.path)
+            status, body = 500, {"status": "error",
+                                 "error": f"{type(exc).__name__}: {exc}"}
+        self._respond(status, body)
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+def make_server(service: InferenceService, host: str = "127.0.0.1",
+                port: int = 8000) -> ThreadingHTTPServer:
+    """Bind (but do not start) the HTTP front end; ``port=0`` picks a free
+    port (tests). Call ``serve_forever()`` or drive it from a thread."""
+    handler = type("BoundHandler", (_Handler,), {"service": service})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_forever(service: InferenceService, host: str, port: int) -> None:
+    server = make_server(service, host, port)
+    logger.info("serving on http://%s:%d (kinds: %s)", host,
+                server.server_address[1], ",".join(service.engine.kinds))
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        service.close()

@@ -12,20 +12,44 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from collections import defaultdict
-from typing import Dict, Iterator, Optional
+from collections import defaultdict, deque
+from typing import Dict, Iterable, Iterator, Optional, Sequence
 
 import jax
 
 logger = logging.getLogger(__name__)
 
 
-class PhaseTimer:
-    """Accumulates wall-clock per named phase across loop iterations."""
+def percentiles(values: Iterable[float], qs: Sequence[float] = (50, 95, 99)) -> Dict[str, float]:
+    """Nearest-rank percentiles of ``values`` as ``{"p50": ..., ...}``
+    (empty dict for no samples). Shared by PhaseTimer.report and the serving
+    latency metrics — one definition so BENCH artifacts and /metrics agree."""
+    import math
 
-    def __init__(self):
+    data = sorted(float(v) for v in values)
+    if not data:
+        return {}
+    out = {}
+    for q in qs:
+        rank = max(1, min(len(data), math.ceil(q / 100.0 * len(data))))
+        out[f"p{q:g}"] = data[rank - 1]
+    return out
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase across loop iterations.
+
+    Keeps the most recent ``max_samples`` per-call durations per phase so
+    ``report()``/``percentile()`` can state tail latency (p50/p95/p99), not
+    just the mean — a mean hides exactly the stalls (recompiles, host syncs)
+    worth finding."""
+
+    def __init__(self, max_samples: int = 65536):
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        self.samples: Dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=max_samples)
+        )
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[list]:
@@ -44,17 +68,26 @@ class PhaseTimer:
             elapsed = time.perf_counter() - start
             self.totals[name] += elapsed
             self.counts[name] += 1
+            self.samples[name].append(elapsed)
 
     def mean(self, name: str) -> float:
         c = self.counts.get(name, 0)
         return self.totals[name] / c if c else 0.0
 
+    def percentile(self, name: str, q: float) -> float:
+        return percentiles(self.samples.get(name, ()), (q,)).get(f"p{q:g}", 0.0)
+
     def report(self) -> str:
         rows = sorted(self.totals.items(), key=lambda kv: -kv[1])
-        return "\n".join(
-            f"{name:>24s}: total {total:8.3f}s  mean {self.mean(name)*1e3:8.2f}ms  n={self.counts[name]}"
-            for name, total in rows
-        )
+        out = []
+        for name, total in rows:
+            ps = percentiles(self.samples.get(name, ()))
+            tail = "  ".join(f"{k} {v*1e3:8.2f}ms" for k, v in ps.items())
+            out.append(
+                f"{name:>24s}: total {total:8.3f}s  mean {self.mean(name)*1e3:8.2f}ms  "
+                f"{tail}  n={self.counts[name]}"
+            )
+        return "\n".join(out)
 
 
 @contextlib.contextmanager

@@ -103,6 +103,12 @@ def write_model(path: str, graph, state, save_updater: bool = True) -> None:
                 zf.writestr("topology.json", json.dumps(graph.to_dict()))
                 zf.writestr("meta.json", json.dumps(meta))
                 zf.writestr("arrays.npz", npz_buf.getvalue())
+            # flush to stable storage BEFORE the rename publishes the file:
+            # without the fsync a crash can publish a name pointing at
+            # not-yet-written bytes — exactly the truncated zip the serving
+            # loader must never see
+            fh.flush()
+            os.fsync(fh.fileno())
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -114,22 +120,33 @@ def read_model(path: str, load_updater: bool = True) -> Tuple[object, Dict, Opti
     """Load a checkpoint: returns (graph, params, opt_state_or_None, step).
 
     The graph is rebuilt from the stored topology, so a checkpoint is
-    self-contained (restorable without the code that defined the model)."""
+    self-contained (restorable without the code that defined the model).
+    A corrupted or truncated file raises ``ValueError`` — a serving loader
+    must reject a half-written artifact loudly, never half-load it."""
     from gan_deeplearning4j_tpu.nn.graph import ComputationGraph
 
-    with zipfile.ZipFile(path, "r") as zf:
-        topology = json.loads(zf.read("topology.json"))
-        meta = json.loads(zf.read("meta.json"))
-        if meta["format_version"] > FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint format {meta['format_version']} is newer than "
-                f"supported {FORMAT_VERSION}"
-            )
-        with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
-            flat = {k: npz[k] for k in npz.files}
-        for key, name in meta.get("array_dtypes", {}).items():
-            # stored as uint16 bit patterns; view back to the real dtype
-            flat[key] = flat[key].view(jnp.dtype(name))
+    try:
+        with zipfile.ZipFile(path, "r") as zf:
+            topology = json.loads(zf.read("topology.json"))
+            meta = json.loads(zf.read("meta.json"))
+            if meta["format_version"] > FORMAT_VERSION:
+                raise ValueError(
+                    f"checkpoint format {meta['format_version']} is newer than "
+                    f"supported {FORMAT_VERSION}"
+                )
+            with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
+                flat = {k: npz[k] for k in npz.files}
+    except zipfile.BadZipFile as exc:
+        raise ValueError(
+            f"corrupted or truncated checkpoint {path!r}: {exc}"
+        ) from exc
+    except KeyError as exc:
+        raise ValueError(
+            f"checkpoint {path!r} is missing a required member: {exc}"
+        ) from exc
+    for key, name in meta.get("array_dtypes", {}).items():
+        # stored as uint16 bit patterns; view back to the real dtype
+        flat[key] = flat[key].view(jnp.dtype(name))
 
     graph = ComputationGraph.from_dict(topology)
     params = _unflatten(flat, "params")

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""serve_bench — closed-loop load generator for the serving subsystem.
+
+Builds fresh MNIST-family artifacts (generator + discriminator-feature
+classifier), publishes them through the serializer exactly as a training
+run would, loads them back through the serving loader, then drives the
+in-process service with a mixed workload: every worker thread loops
+submit→wait→submit (closed loop) over randomized request kinds and batch
+sizes. Writes a BENCH-style JSON artifact with throughput, latency
+percentiles, batch-occupancy histogram, shed counts, and the distinct-
+compile count — and FAILS (exit 1) if any serving invariant breaks:
+
+- zero lost requests: every submit returns ok or an explicit shed;
+- bounded compiles: per-kind XLA compiles ≤ the bucket-ladder size
+  (mixed request sizes must ride the padded buckets, never re-compile).
+
+CPU run (the CI shape)::
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py \\
+        --requests 200 --threads 8 --buckets 1,8,32 \\
+        --output artifacts/serve_bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def build_bundle(directory: str, seed: int = 666) -> dict:
+    """Fresh (untrained) MNIST artifacts through the REAL publish path:
+    build graphs, then write serving checkpoints + manifest with
+    ``write_model`` — the bench exercises the same loader a trained bundle
+    would hit, and weights don't change the serving-layer physics."""
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig
+    from gan_deeplearning4j_tpu.models import registry
+    from gan_deeplearning4j_tpu.utils import write_model
+
+    cfg = ExperimentConfig(seed=seed)
+    family = registry.get("mnist")
+    model_cfg = family.make_model_config(cfg)
+    dis = family.build_discriminator(model_cfg)
+    gen = family.build_generator(model_cfg)
+    dis_params = dis.init()
+    cv, cv_params = family.build_transfer_classifier(dis, dis_params, model_cfg)
+    gen_path = os.path.join(directory, "bench_gen_serving.zip")
+    cv_path = os.path.join(directory, "bench_CV_serving.zip")
+    write_model(gen_path, gen, gen.init(), save_updater=False)
+    write_model(cv_path, cv, cv_params, save_updater=False)
+    return {
+        "generator": gen_path,
+        "classifier": cv_path,
+        "feature_vertex": list(family.dis_to_cv.values())[-1],
+        "z_size": model_cfg.z_size,
+        "num_features": cfg.num_features,
+    }
+
+
+def run_bench(args) -> dict:
+    from gan_deeplearning4j_tpu.serving import InferenceService, ServingEngine
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bundle = build_bundle(tmp, seed=args.seed)
+        engine = ServingEngine.from_checkpoints(
+            generator=bundle["generator"],
+            classifier=bundle["classifier"],
+            buckets=args.buckets,
+            feature_vertex=bundle["feature_vertex"],
+        )
+        t_compile = time.perf_counter()
+        engine.warmup()
+        compile_s = time.perf_counter() - t_compile
+        service = InferenceService(
+            engine,
+            max_latency=args.max_latency,
+            max_queue=args.max_queue,
+            default_timeout=args.timeout,
+            warmup=False,
+        )
+
+        width = {"sample": bundle["z_size"],
+                 "classify": bundle["num_features"],
+                 "features": bundle["num_features"]}
+        kinds = list(engine.kinds)
+        sizes = [s for s in args.sizes if s <= max(args.buckets)]
+        statuses = []  # one entry per request — the zero-lost ledger
+        lock = threading.Lock()
+        per_thread = args.requests // args.threads
+        rows_done = [0]
+
+        def worker(widx: int) -> None:
+            rng = np.random.default_rng(args.seed + widx)
+            for i in range(per_thread):
+                kind = kinds[rng.integers(len(kinds))]
+                n = int(sizes[rng.integers(len(sizes))])
+                rows = rng.random((n, width[kind]), dtype=np.float32)
+                if kind == "sample":
+                    rows = rows * 2.0 - 1.0
+                res = service.batcher.submit(kind, rows)
+                with lock:
+                    statuses.append(res.status)
+                    if res.ok:
+                        rows_done[0] += res.data.shape[0]
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(args.threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        metrics = service.metrics()
+        service.close()
+
+    submitted = args.threads * per_thread
+    lost = submitted - len(statuses)
+    ok = sum(1 for s in statuses if s == "ok")
+    shed = sum(1 for s in statuses if s in ("overloaded", "deadline"))
+    errors = sum(1 for s in statuses if s == "error")
+    compile_counts = metrics["compile_counts"]
+    summary = {
+        "bench": "serve_bench",
+        "config": {
+            "requests": submitted,
+            "threads": args.threads,
+            "buckets": list(args.buckets),
+            "sizes": sizes,
+            "max_latency_s": args.max_latency,
+            "max_queue": args.max_queue,
+            "timeout_s": args.timeout,
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "results": {
+            "ok": ok,
+            "shed": shed,
+            "errors": errors,
+            "lost": lost,
+            "elapsed_s": elapsed,
+            "warmup_compile_s": compile_s,
+            "throughput_rps": submitted / elapsed if elapsed > 0 else 0.0,
+            "throughput_rows_per_s": rows_done[0] / elapsed if elapsed > 0 else 0.0,
+            "latency_ms": metrics["latency_ms"],
+            "batch_occupancy": metrics["batch_occupancy"],
+            "flushes": metrics["flushes"],
+            "compile_counts": compile_counts,
+        },
+        "invariants": {
+            "zero_lost": lost == 0 and errors == 0,
+            "compiles_bounded": all(
+                c <= len(args.buckets) for c in compile_counts.values()
+            ),
+        },
+    }
+    return summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--threads", type=int, default=8)
+    p.add_argument("--buckets", default="1,8,32",
+                   type=lambda s: tuple(int(b) for b in s.split(",")))
+    p.add_argument("--sizes", default="1,2,5,8,13,32",
+                   type=lambda s: [int(b) for b in s.split(",")],
+                   help="request batch sizes the generator mixes over")
+    p.add_argument("--max-latency", type=float, default=0.002)
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--seed", type=int, default=666)
+    p.add_argument("--output", default=os.path.join(_REPO, "artifacts", "serve_bench.json"))
+    args = p.parse_args(argv)
+
+    summary = run_bench(args)
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
+    with open(args.output, "w") as fh:
+        json.dump(summary, fh, indent=2)
+        fh.write("\n")
+    sys.stdout.write(json.dumps(summary["results"], indent=2) + "\n")
+    bad = [k for k, v in summary["invariants"].items() if not v]
+    if bad:
+        sys.stderr.write(f"serve_bench: invariants violated: {bad}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

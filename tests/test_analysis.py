@@ -964,6 +964,111 @@ class TestAxisSizeMismatch:
 
 
 # ===========================================================================
+# JG012 — dead out_shardings on donated buffers
+# ===========================================================================
+
+class TestDeadDonatedOutSharding:
+    def test_true_positive_donated_sharding_absent_from_outputs(self):
+        # the donated state is replicated in but every output is resharded
+        # to `data` — XLA can never alias the donated buffer; peak HBM is
+        # silently double what the donation promises
+        r = run(
+            "import jax\n"
+            "def build(f, rep, data):\n"
+            "    return jax.jit(f, donate_argnums=(0,),\n"
+            "                   in_shardings=(rep, data),\n"
+            "                   out_shardings=(data,))\n"
+        )
+        assert codes(r) == ["JG012"]
+        assert "rep" in r.active[0].message
+        assert "dead" in r.active[0].message
+
+    def test_true_positive_kwargs_builder_idiom(self):
+        # the harness/experiment.py builder shape: donate in the dict
+        # literal, shardings assigned conditionally by subscript
+        r = run(
+            "import jax\n"
+            "def build(f, mesh, rep, data):\n"
+            "    kwargs = {'donate_argnums': (0, 1)}\n"
+            "    if mesh is not None:\n"
+            "        kwargs['in_shardings'] = (rep,) * 2 + (data,) * 2\n"
+            "        kwargs['out_shardings'] = (data,) * 2\n"
+            "    return jax.jit(f, **kwargs)\n"
+        )
+        assert codes(r) == ["JG012"]
+
+    def test_true_negative_matching_sharding_present(self):
+        # the repo's actual trainer shape: donated state goes in replicated
+        # and comes back replicated — the donation can alias
+        r = run(
+            "import jax\n"
+            "def build(f, rep, data):\n"
+            "    return jax.jit(f, donate_argnums=(0,),\n"
+            "                   in_shardings=(rep, data, data, rep),\n"
+            "                   out_shardings=(rep, rep))\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_repetition_idiom_matches(self):
+        r = run(
+            "import jax\n"
+            "def build(f, rep, stacked, data):\n"
+            "    kwargs = {'donate_argnums': (0, 1, 2, 3)}\n"
+            "    kwargs['in_shardings'] = (rep,) * 4 + (stacked,) * 2 + (data,) * 2\n"
+            "    kwargs['out_shardings'] = (rep,) * 4 + (rep,) * 3\n"
+            "    return jax.jit(f, **kwargs)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_no_out_shardings_declared(self):
+        # without out_shardings XLA is free to alias — nothing to flag
+        r = run(
+            "import jax\n"
+            "def build(f, rep, data):\n"
+            "    return jax.jit(f, donate_argnums=(0,), in_shardings=(rep, data))\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_unresolvable_specs_are_silence(self):
+        r = run(
+            "import jax\n"
+            "def build(f, shardings):\n"
+            "    return jax.jit(f, donate_argnums=(0,),\n"
+            "                   in_shardings=shardings[0],\n"
+            "                   out_shardings=shardings[1])\n"
+        )
+        assert codes(r) == []
+
+    def test_single_sharding_broadcast_compares(self):
+        # a lone sharding broadcasts to every input leaf; matching single
+        # out_shardings means the donation can alias
+        r = run(
+            "import jax\n"
+            "def build(f, rep, data):\n"
+            "    return jax.jit(f, donate_argnums=(0,),\n"
+            "                   in_shardings=rep, out_shardings=rep)\n"
+        )
+        assert codes(r) == []
+        r = run(
+            "import jax\n"
+            "def build(f, rep, data):\n"
+            "    return jax.jit(f, donate_argnums=(0,),\n"
+            "                   in_shardings=rep, out_shardings=data)\n"
+        )
+        assert codes(r) == ["JG012"]
+
+    def test_suppression_applies(self):
+        r = run(
+            "import jax\n"
+            "def build(f, rep, data):\n"
+            "    return jax.jit(f, donate_argnums=(0,),  # jaxlint: disable=JG012\n"
+            "                   in_shardings=(rep,), out_shardings=(data,))\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG012"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

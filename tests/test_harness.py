@@ -325,6 +325,64 @@ class TestFamilies:
             ExperimentConfig(model_family="bogus").validate()
 
 
+class TestCheckpointCadence:
+    """checkpoint_every: configurable per-iteration checkpoint interval
+    (default 1 = the reference's every-iteration cadence)."""
+
+    def _tabular_cfg(self, **overrides):
+        from gan_deeplearning4j_tpu.harness import ExperimentConfig
+
+        base = dict(
+            model_family="tabular", num_features=16, z_size=4,
+            batch_size_train=8, batch_size_pred=8,
+            height=1, width=1, channels=1, save_models=True,
+        )
+        base.update(overrides)
+        return ExperimentConfig(**base)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="checkpoint_every"):
+            self._tabular_cfg(checkpoint_every=0).validate()
+
+    def test_checkpoint_every_gates_saves(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+
+        cfg = self._tabular_cfg(
+            num_iterations=4, checkpoint_every=2,
+            output_dir=str(tmp_path / "out"),
+        )
+        exp = GanExperiment(cfg)
+        saved = []
+        exp.save_models = lambda: saved.append(exp.batch_counter)
+        feats = exp.family.synthetic_data(32, exp.model_cfg, 0)
+        labels = np.eye(10, dtype=np.float32)[np.arange(32) % 10]
+        it = ArrayDataSetIterator(feats, labels, batch_size=8)
+        exp.run(it)
+        # reference cadence is every iteration; every-2 halves the
+        # checkpoint IO while the boundary iterations still save — and the
+        # run ends with a final-state save (iteration 3 is off-cadence, so
+        # without it resume/publish would see weights 1 iteration stale)
+        assert saved == [0, 2, 4]
+
+    def test_window_limit_respects_checkpoint_cadence(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+
+        common = dict(
+            num_iterations=32, loss_fetch_every=8,
+            print_every=4, save_every=4, output_dir=str(tmp_path / "o"),
+        )
+        # per-iteration checkpointing pins the device loop to windows of 1
+        exp = GanExperiment(self._tabular_cfg(checkpoint_every=1, **common))
+        exp.batch_counter = 1
+        assert exp._window_limit(False) == 1
+        # a sparser cadence re-opens the window up to its boundary
+        exp4 = GanExperiment(self._tabular_cfg(checkpoint_every=4, **common))
+        exp4.batch_counter = 1
+        assert exp4._window_limit(False) == 4
+        exp4.batch_counter = 4  # at a boundary: the state must be current
+        assert exp4._window_limit(False) == 1
+
+
 class TestResume:
     @pytest.mark.slow
     def test_save_then_load_roundtrip(self, tmp_path):

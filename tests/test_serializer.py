@@ -98,6 +98,76 @@ class TestSerializer:
         assert_trees_equal(params, params2)
         assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
 
+    def test_restore_without_updater_state(self, tmp_path):
+        """The serving loader's read shape: topology + params only, even
+        from a checkpoint that carries full updater state."""
+        gen = build_generator()
+        trainer = GraphTrainer(gen)
+        state = trainer.init_state()
+        path = os.path.join(tmp_path, "full.zip")
+        write_model(path, gen, state, save_updater=True)
+        graph2, params, opt_state, step = read_model(path, load_updater=False)
+        assert opt_state is None
+        assert_trees_equal(state.params, params)
+        z = jnp.full((3, 2), 0.25)
+        np.testing.assert_allclose(
+            np.asarray(gen.output(state.params, z)),
+            np.asarray(graph2.output(params, z)),
+            rtol=1e-6,
+        )
+
+    def test_restore_in_fresh_process_without_defining_code(self, tmp_path):
+        """A checkpoint is self-contained: a fresh interpreter that never
+        imports the model-zoo builders restores topology + params and runs
+        a forward pass — exactly what a serving replica does."""
+        import subprocess
+        import sys
+
+        gen = build_generator()
+        path = os.path.join(tmp_path, "gen.zip")
+        write_model(path, gen, gen.init(), save_updater=False)
+        expect = np.asarray(gen.output(gen.init(), jnp.zeros((2, 2))))
+        script = (
+            "import sys, numpy as np, jax.numpy as jnp\n"
+            "from gan_deeplearning4j_tpu.utils.serializer import read_model\n"
+            # forbid the defining code path: restoring must not need it
+            "sys.modules['gan_deeplearning4j_tpu.models'] = None\n"
+            "graph, params, opt, step = read_model(sys.argv[1])\n"
+            "assert opt is None and step == 0\n"
+            "out = np.asarray(graph.output(params, jnp.zeros((2, 2))))\n"
+            "np.save(sys.argv[2], out)\n"
+        )
+        out_path = os.path.join(tmp_path, "fwd.npy")
+        proc = subprocess.run(
+            [sys.executable, "-c", script, path, out_path],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        np.testing.assert_allclose(np.load(out_path), expect, rtol=1e-6)
+
+    def test_truncated_zip_rejected(self, tmp_path):
+        """A killed writer must never let a reader half-load: truncated
+        bytes raise ValueError (not a silent partial tree)."""
+        gen = build_generator()
+        path = os.path.join(tmp_path, "t.zip")
+        write_model(path, gen, gen.init())
+        data = open(path, "rb").read()
+        for frac in (0.2, 0.9):
+            bad = os.path.join(tmp_path, f"bad_{frac}.zip")
+            with open(bad, "wb") as fh:
+                fh.write(data[: int(len(data) * frac)])
+            with pytest.raises(ValueError, match="corrupt|truncat|missing"):
+                read_model(bad)
+
+    def test_garbage_file_rejected(self, tmp_path):
+        bad = os.path.join(tmp_path, "junk.zip")
+        with open(bad, "wb") as fh:
+            fh.write(b"not a zip at all")
+        with pytest.raises(ValueError, match="corrupt|truncat"):
+            read_model(bad)
+
     def test_future_version_rejected(self, tmp_path):
         import json
         import zipfile

@@ -1,0 +1,493 @@
+"""serving/ subsystem tests: bucket-ladder engine, micro-batcher policy
+(coalescing, deadlines, backpressure), the in-process + HTTP service, the
+publish→load round trip, and the serve_bench invariants (slow).
+
+Engine tests use tiny dense graphs (millisecond compiles) — the serving
+layer is model-agnostic, so the physics is identical to the MNIST stack the
+bench drives. The fast service smoke below is the tier-1 acceptance item:
+in-process service, 2 buckets, ~50 mixed requests, zero lost.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.nn import (
+    DenseLayer,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.serving import (
+    InferenceService,
+    MicroBatcher,
+    ServingEngine,
+    make_server,
+)
+from gan_deeplearning4j_tpu.utils import write_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+Z, FEAT, CLASSES, HIDDEN = 4, 6, 3, 5
+
+
+def tiny_generator():
+    b = GraphBuilder(GraphConfig(seed=1))
+    b.add_inputs("z").set_input_types(InputType.feed_forward(Z))
+    b.add_layer("g_dense_1", DenseLayer(n_out=8), "z")
+    b.add_layer(
+        "g_out", OutputLayer(n_out=FEAT, activation="sigmoid", loss="xent"),
+        "g_dense_1",
+    )
+    b.set_outputs("g_out")
+    return b.build()
+
+
+def tiny_classifier():
+    b = GraphBuilder(GraphConfig(seed=2))
+    b.add_inputs("x").set_input_types(InputType.feed_forward(FEAT))
+    b.add_layer("feat_1", DenseLayer(n_out=HIDDEN), "x")
+    b.add_layer(
+        "cv_out",
+        OutputLayer(n_out=CLASSES, activation="softmax", loss="mcxent"),
+        "feat_1",
+    )
+    b.set_outputs("cv_out")
+    return b.build()
+
+
+@pytest.fixture(scope="module")
+def checkpoints(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serving_ckpt")
+    gen, cv = tiny_generator(), tiny_classifier()
+    gen_path, cv_path = str(tmp / "gen.zip"), str(tmp / "cv.zip")
+    write_model(gen_path, gen, gen.init(), save_updater=False)
+    write_model(cv_path, cv, cv.init(), save_updater=False)
+    return gen_path, cv_path
+
+
+@pytest.fixture(scope="module")
+def engine(checkpoints):
+    gen_path, cv_path = checkpoints
+    eng = ServingEngine.from_checkpoints(
+        generator=gen_path, classifier=cv_path,
+        buckets=(1, 8), feature_vertex="feat_1",
+    )
+    eng.warmup()
+    return eng
+
+
+class TestEngine:
+    def test_kinds_and_widths(self, engine):
+        assert set(engine.kinds) == {"sample", "classify", "features"}
+        assert engine.input_width("sample") == Z
+        assert engine.input_width("classify") == FEAT
+
+    def test_padding_is_invisible(self, engine):
+        """A size-5 request rides the 8-bucket; rows come back unpadded and
+        equal to the unbatched forward (padding rows never leak)."""
+        x = np.random.default_rng(0).random((5, FEAT), dtype=np.float32)
+        out = engine.run("classify", x)
+        assert out.shape == (5, CLASSES)
+        np.testing.assert_allclose(
+            out,
+            np.asarray(engine.run("classify", np.concatenate([x, x]))[:5]),
+            rtol=1e-5,
+        )
+
+    def test_compile_count_bounded_by_ladder(self, engine):
+        """Mixed request sizes reuse the padded buckets — the serve-path
+        recompile hazard the ladder exists to kill."""
+        before = engine.compile_counts
+        for n in (1, 2, 3, 5, 7, 8, 4, 6):
+            engine.run("sample", np.zeros((n, Z), np.float32))
+            engine.run("features", np.zeros((n, FEAT), np.float32))
+        assert engine.compile_counts == before  # warmup covered the ladder
+        assert all(c <= len(engine.buckets) for c in engine.compile_counts.values())
+
+    def test_oversized_batch_chunks_through_top_bucket(self, engine):
+        out = engine.run("classify", np.zeros((20, FEAT), np.float32))
+        assert out.shape == (20, CLASSES)
+        assert engine.compile_counts["classify"] <= len(engine.buckets)
+
+    def test_features_returns_feature_vertex_activation(self, engine):
+        out = engine.run("features", np.zeros((2, FEAT), np.float32))
+        assert out.shape == (2, HIDDEN)
+
+    def test_bad_inputs_rejected(self, engine):
+        with pytest.raises(KeyError, match="unknown request kind"):
+            engine.run("nope", np.zeros((1, FEAT), np.float32))
+        with pytest.raises(ValueError, match="expected"):
+            engine.run("classify", np.zeros((1, FEAT + 1), np.float32))
+        with pytest.raises(ValueError, match="expected"):
+            engine.run("classify", np.zeros((0, FEAT), np.float32))
+
+    def test_generator_only_engine_has_no_classify(self, checkpoints):
+        gen_path, _ = checkpoints
+        eng = ServingEngine.from_checkpoints(generator=gen_path, buckets=(1,))
+        assert eng.kinds == ("sample",)
+
+    def test_unknown_feature_vertex_rejected(self, checkpoints):
+        gen_path, cv_path = checkpoints
+        with pytest.raises(ValueError, match="feature vertex"):
+            ServingEngine.from_checkpoints(
+                generator=gen_path, classifier=cv_path,
+                buckets=(1,), feature_vertex="not_a_vertex",
+            )
+
+
+class TestBatcher:
+    """Policy tests against a fake engine — no jax, pure threading."""
+
+    def test_coalesces_concurrent_requests(self):
+        batches = []
+
+        def run_fn(kind, rows):
+            batches.append((kind, rows.shape[0]))
+            time.sleep(0.01)
+            return rows * 2.0
+
+        mb = MicroBatcher(run_fn, max_batch=16, max_latency=0.05, max_queue=64)
+        results = [None] * 8
+
+        def client(i):
+            results[i] = mb.submit("k", np.full((2, 3), float(i), np.float32))
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mb.close()
+        assert all(r.ok for r in results)
+        for i, r in enumerate(results):  # each caller gets ITS rows back
+            np.testing.assert_array_equal(r.data, np.full((2, 3), 2.0 * i))
+        # coalescing happened: fewer flushes than requests
+        assert len(batches) < 8
+        m = mb.metrics()
+        assert m["submitted"] == {"k": 8} and m["completed"] == {"k": 8}
+        assert sum(m["batch_occupancy"].values()) == m["flushes"]
+
+    def test_backpressure_sheds_immediately_when_full(self):
+        """The acceptance criterion: with a full queue, a new request is
+        shed within its deadline instead of blocking indefinitely."""
+        release, running = threading.Event(), threading.Event()
+
+        def slow_fn(kind, rows):
+            running.set()
+            release.wait(5.0)
+            return rows
+
+        mb = MicroBatcher(slow_fn, max_batch=4, max_latency=0.0, max_queue=1,
+                          default_timeout=10.0)
+        first = {}
+        t = threading.Thread(
+            target=lambda: first.setdefault(
+                "r", mb.submit("k", np.zeros((1, 2), np.float32))
+            )
+        )
+        t.start()
+        assert running.wait(5.0)  # worker is inside the engine, queue empty
+        # fill the queue with one waiter…
+        t2 = threading.Thread(
+            target=lambda: first.setdefault(
+                "r2", mb.submit("k", np.zeros((1, 2), np.float32))
+            )
+        )
+        t2.start()
+        deadline = time.monotonic() + 2.0
+        while mb.metrics()["queue_depth"] < 1:
+            assert time.monotonic() < deadline, "second request never queued"
+            time.sleep(0.001)
+        # …then the overflow request must shed NOW, not after 10 s
+        t0 = time.monotonic()
+        shed = mb.submit("k", np.zeros((1, 2), np.float32), timeout=10.0)
+        elapsed = time.monotonic() - t0
+        assert shed.status == "overloaded"
+        assert elapsed < 1.0  # immediate, not deadline-bound
+        release.set()
+        t.join(5.0)
+        t2.join(5.0)
+        mb.close()
+        assert first["r"].ok and first["r2"].ok
+        assert mb.metrics()["shed_overloaded"] == 1
+
+    def test_deadline_expiry_sheds_before_device_work(self):
+        ran = []
+
+        def slow_fn(kind, rows):
+            ran.append(rows.shape[0])
+            time.sleep(0.2)
+            return rows
+
+        mb = MicroBatcher(slow_fn, max_batch=4, max_latency=0.0, max_queue=8)
+        hold = threading.Thread(
+            target=lambda: mb.submit("k", np.zeros((1, 2), np.float32))
+        )
+        hold.start()
+        while not ran:
+            time.sleep(0.001)
+        # queued behind a 200 ms flush with a 50 ms budget: must expire
+        res = mb.submit("k", np.zeros((3, 2), np.float32), timeout=0.05)
+        assert res.status == "deadline"
+        hold.join(5.0)
+        mb.close()
+        assert mb.metrics()["shed_deadline"] == 1
+        assert ran == [1]  # the expired request never reached the engine
+
+    def test_engine_error_propagates_as_error_result(self):
+        def bad_fn(kind, rows):
+            raise RuntimeError("boom")
+
+        mb = MicroBatcher(bad_fn, max_latency=0.0)
+        res = mb.submit("k", np.zeros((1, 2), np.float32), timeout=1.0)
+        mb.close()
+        assert res.status == "error" and "boom" in res.error
+        assert mb.metrics()["errors"] == 1
+
+    def test_malformed_rows_rejected_client_side(self):
+        mb = MicroBatcher(lambda k, r: r)
+        res = mb.submit("k", np.zeros((3,), np.float32))
+        mb.close()
+        assert res.status == "error" and "expected" in res.error
+
+    def test_width_mismatched_rider_cannot_kill_the_worker(self):
+        """A bad request coalesced with a good one must error its batch,
+        not crash the worker thread and wedge the service."""
+        mb = MicroBatcher(lambda k, r: r, max_batch=8, max_latency=0.05)
+        results = {}
+
+        def client(name, width):
+            results[name] = mb.submit("k", np.zeros((1, width), np.float32),
+                                      timeout=5.0)
+
+        threads = [
+            threading.Thread(target=client, args=("a", 2)),
+            threading.Thread(target=client, args=("b", 3)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # whatever happened to the mixed batch, the worker must survive
+        # and serve the next request
+        after = mb.submit("k", np.ones((2, 2), np.float32), timeout=5.0)
+        mb.close()
+        assert after.ok
+        assert all(r.status in ("ok", "error") for r in results.values())
+
+    def test_close_without_drain_keeps_the_ledger(self):
+        release, running = threading.Event(), threading.Event()
+
+        def slow_fn(kind, rows):
+            running.set()
+            release.wait(5.0)
+            return rows
+
+        mb = MicroBatcher(slow_fn, max_latency=0.0, max_queue=8)
+        done = {}
+        t1 = threading.Thread(target=lambda: done.setdefault(
+            "a", mb.submit("k", np.zeros((1, 2), np.float32))))
+        t1.start()
+        assert running.wait(5.0)
+        t2 = threading.Thread(target=lambda: done.setdefault(
+            "b", mb.submit("k", np.zeros((1, 2), np.float32))))
+        t2.start()
+        deadline = time.monotonic() + 2.0
+        while mb.metrics()["queue_depth"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        # close() joins the worker, which is blocked inside the engine —
+        # release it a beat later so close returns promptly
+        threading.Timer(0.2, release.set).start()
+        mb.close(drain=False)  # sheds the queued request, counted
+        t1.join(5.0)
+        t2.join(5.0)
+        m = mb.metrics()
+        total = (sum(m["completed"].values()) + m["shed_overloaded"]
+                 + m["shed_deadline"] + m["errors"])
+        assert sum(m["submitted"].values()) == total == 2
+
+
+class TestServiceSmoke:
+    """The tier-1 fast smoke: in-process service, 2 buckets, ~50 mixed
+    requests from concurrent clients — every request accounted for."""
+
+    def test_fifty_mixed_requests_zero_lost(self, engine):
+        svc = InferenceService(engine, max_latency=0.002, max_queue=64,
+                               default_timeout=30.0, warmup=True)
+        width = {"sample": Z, "classify": FEAT, "features": FEAT}
+        statuses = []
+        lock = threading.Lock()
+
+        def client(widx):
+            rng = np.random.default_rng(widx)
+            for _ in range(10):
+                kind = engine.kinds[rng.integers(len(engine.kinds))]
+                n = int(rng.integers(1, 9))
+                res = svc.batcher.submit(
+                    kind, rng.random((n, width[kind]), dtype=np.float32)
+                )
+                with lock:
+                    statuses.append((kind, n, res))
+
+        threads = [threading.Thread(target=client, args=(w,)) for w in range(5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        metrics = svc.metrics()
+        svc.close()
+        assert len(statuses) == 50  # zero lost: one result per submit
+        for kind, n, res in statuses:
+            assert res.ok, (kind, res.status, res.error)
+            assert res.data.shape[0] == n
+        # metrics schema: the /metrics contract docs/SERVING.md pins
+        assert sum(metrics["completed"].values()) == 50
+        for kind in engine.kinds:
+            lat = metrics["latency_ms"].get(kind)
+            if lat:
+                assert {"p50", "p95", "p99"} <= set(lat)
+                assert lat["p50"] <= lat["p95"] <= lat["p99"]
+        assert metrics["compile_counts"] == engine.compile_counts
+        assert all(
+            c <= len(engine.buckets) for c in metrics["compile_counts"].values()
+        )
+
+    def test_healthz_and_routing(self, engine):
+        svc = InferenceService(engine, warmup=False)
+        code, body = svc.handle("GET", "/healthz")
+        assert code == 200 and body["status"] == "ok"
+        assert set(body["kinds"]) == set(engine.kinds)
+        code, body = svc.handle("POST", "/v1/classify", {"data": [[0.1] * FEAT]})
+        assert code == 200 and body["status"] == "ok"
+        assert len(body["data"]) == 1 and len(body["data"][0]) == CLASSES
+        code, body = svc.handle("POST", "/v1/nope", {"data": [[1.0]]})
+        assert code == 404
+        code, body = svc.handle("POST", "/v1/classify", {})
+        assert code == 400
+        code, body = svc.handle("POST", "/v1/classify", {"data": "junk"})
+        assert code == 400
+        # malformed shapes 400 at the boundary — they never reach a batch
+        code, body = svc.handle("POST", "/v1/classify", {"data": [[]]})
+        assert code == 400 and "expected" in body["error"]
+        code, body = svc.handle("POST", "/v1/classify",
+                                {"data": [[0.1] * (FEAT + 1)]})
+        assert code == 400
+        # non-numeric timeout is a 400, not a handler-thread crash
+        code, body = svc.handle("POST", "/v1/classify",
+                                {"data": [[0.1] * FEAT], "timeout": "abc"})
+        assert code == 400 and "timeout" in body["error"]
+        code, body = svc.handle("POST", "/v1/classify",
+                                {"data": [[0.1] * FEAT], "timeout": "5"})
+        assert code == 200  # numeric strings coerce
+        svc.close()
+
+
+class TestHttpServer:
+    def test_http_round_trip(self, engine):
+        svc = InferenceService(engine, warmup=False)
+        server = make_server(svc, port=0)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                assert json.loads(r.read())["status"] == "ok"
+            req = urllib.request.Request(
+                f"{base}/v1/sample",
+                data=json.dumps({"data": [[0.0] * Z, [0.5] * Z]}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = json.loads(r.read())
+            assert body["status"] == "ok" and len(body["data"]) == 2
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                metrics = json.loads(r.read())
+            assert metrics["completed"].get("sample") == 1
+        finally:
+            server.shutdown()
+            server.server_close()
+            svc.close()
+
+
+class TestPublishRoundTrip:
+    def test_publish_for_serving_then_load_bundle(self, tmp_path):
+        """The deploy path end to end: experiment → bundle → engine, no
+        training code on the load side."""
+        from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+
+        cfg = ExperimentConfig(
+            batch_size_train=8, num_iterations=1, latent_grid=2,
+            output_dir=str(tmp_path / "out"), save_models=False,
+        )
+        exp = GanExperiment(cfg)
+        manifest = exp.publish_for_serving(str(tmp_path / "bundle"))
+        assert manifest["classifier"] is not None
+        assert manifest["feature_vertex"] == "dis_dense_layer_6"
+        bundle_dir = manifest["directory"]
+        assert os.path.exists(os.path.join(bundle_dir, "serving.json"))
+        assert not [f for f in os.listdir(bundle_dir) if f.endswith(".tmp")]
+
+        eng = ServingEngine.from_bundle(bundle_dir, buckets=(4,))
+        assert set(eng.kinds) == {"sample", "classify", "features"}
+        probs = eng.run(
+            "classify", np.zeros((3, manifest["num_features"]), np.float32)
+        )
+        assert probs.shape == (3, manifest["num_classes"])
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+        z = np.zeros((2, manifest["z_size"]), np.float32)
+        assert eng.run("sample", z).shape == (2, manifest["num_features"])
+
+    def test_bundle_checkpoints_have_no_updater_state(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+        from gan_deeplearning4j_tpu.utils import read_model
+
+        cfg = ExperimentConfig(
+            batch_size_train=8, num_iterations=1,
+            output_dir=str(tmp_path / "out"), save_models=False,
+        )
+        manifest = GanExperiment(cfg).publish_for_serving(str(tmp_path / "b"))
+        for key in ("generator", "classifier"):
+            _, _, opt_state, _ = read_model(
+                os.path.join(manifest["directory"], manifest[key])
+            )
+            assert opt_state is None
+
+
+@pytest.mark.slow
+class TestServeBench:
+    def test_bench_script_invariants(self, tmp_path):
+        """serve_bench on CPU: mixed sizes complete with zero lost requests,
+        bounded compiles, and a BENCH JSON artifact on disk."""
+        out = str(tmp_path / "serve_bench.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+             "--requests", "48", "--threads", "4", "--buckets", "1,8",
+             "--sizes", "1,3,8", "--output", out],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        with open(out) as fh:
+            summary = json.load(fh)
+        res = summary["results"]
+        assert summary["invariants"]["zero_lost"]
+        assert summary["invariants"]["compiles_bounded"]
+        assert res["lost"] == 0 and res["errors"] == 0
+        assert res["ok"] + res["shed"] == summary["config"]["requests"]
+        assert res["throughput_rps"] > 0
+        for kind, counts in res["compile_counts"].items():
+            assert counts <= 2, (kind, counts)
+        for lat in res["latency_ms"].values():
+            assert {"p50", "p95", "p99"} <= set(lat)
