@@ -24,6 +24,7 @@ from gan_deeplearning4j_tpu.analysis.rules.callbacks import CallbackInTimedRegio
 from gan_deeplearning4j_tpu.analysis.rules.donation_flow import DonationFlow
 from gan_deeplearning4j_tpu.analysis.rules.axes import AxisSizeMismatch
 from gan_deeplearning4j_tpu.analysis.rules.sharding import DeadDonatedOutSharding
+from gan_deeplearning4j_tpu.analysis.rules.mesh_axes import MeshAxisMismatch
 
 RULES = [
     PrngKeyReuse(),
@@ -38,6 +39,7 @@ RULES = [
     DonationFlow(),
     AxisSizeMismatch(),
     DeadDonatedOutSharding(),
+    MeshAxisMismatch(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,229 @@
+"""JG013 — sharding spec names an axis the mesh does not have.
+
+A ``PartitionSpec`` is only meaningful relative to a mesh: every axis name
+it mentions must be an axis of the mesh it is paired with (via
+``NamedSharding(mesh, spec)`` or ``shard_map(..., mesh=mesh,
+in_specs=..., out_specs=...)``). Get a name wrong — a renamed mesh axis,
+a spec copy-pasted from a 2-D-mesh trainer into a 1-D-mesh consumer — and
+jax raises only when the sharding is first USED, which on this repo's
+target platform is minutes into a run, after the XLA compile queue, on an
+exclusively-held chip. The serving engine's replica mesh
+(``serving/engine.py``: a 1-D ``("replica",)`` mesh whose bulk lane
+shards batches with ``PartitionSpec("replica")``) is the in-tree consumer
+this rule watches; the training meshes (``("data",)``, harness +
+parallel/) are the other.
+
+The rule fires only on statically-certain evidence: the mesh variable
+must be bound exactly once in the same scope to a ``Mesh``/``make_mesh``
+construction with a literal axis-name tuple, and the spec must be a
+``PartitionSpec(...)`` call with literal string axes. It flags
+
+1. an axis name that is not an axis of the mesh, and
+2. one mesh axis used for two different dimensions of one spec (invalid:
+   an axis can shard at most one dimension).
+
+``None`` entries, unresolvable meshes, and non-literal specs are silence,
+not a guess.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_MESH_CTORS = {
+    "jax.sharding.Mesh", "jax.interpreters.pxla.Mesh", "jax.make_mesh",
+    "jax.experimental.mesh_utils.Mesh", "jax.sharding.make_mesh",
+}
+_NAMED_SHARDING = {"jax.sharding.NamedSharding"}
+_SHARD_MAP = {"jax.shard_map", "jax.experimental.shard_map.shard_map"}
+_PSPEC = {"jax.sharding.PartitionSpec"}
+
+
+def _axis_names(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Literal mesh axis names: a tuple/list of str constants, or a lone
+    str constant (a 1-D mesh may be declared either way)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        names = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            names.append(elt.value)
+        return tuple(names)
+    return None
+
+
+def _scope_walk(scope):
+    """Walk the scope's OWN statements. ``walk_excluding_defs`` skips defs
+    it meets as children but descends into defs handed to it as roots —
+    and a module's body contains its functions as root statements — so
+    nested defs are filtered from the roots first (they are separate
+    scopes, visited on their own by ``iter_scopes``)."""
+    body = [s for s in (getattr(scope, "body", []) or [])
+            if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    return _common.walk_excluding_defs(body)
+
+
+def _direct_bindings(node: ast.AST) -> set:
+    """Names bound by THIS node's own targets (never descendants — the
+    caller walks every node, so counting subtrees would double-count)."""
+    out: set = set()
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        return _common.assignment_targets(node)
+    if isinstance(node, ast.NamedExpr):
+        _common._target_names(node.target, out)
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        _common._target_names(node.target, out)
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+            if item.optional_vars is not None:
+                _common._target_names(item.optional_vars, out)
+    elif isinstance(node, (ast.Import, ast.ImportFrom)):
+        for alias in node.names:
+            out.add((alias.asname or alias.name).split(".")[0])
+    return out
+
+
+def _mesh_bindings(scope, mod) -> Dict[str, Tuple[str, ...]]:
+    """name -> axis names, for names whose ONLY binding in ``scope`` is a
+    mesh construction with a literal axis_names argument. A name rebound
+    anywhere else in the scope — to another mesh OR to anything at all
+    (a helper call, an attribute) — is dropped as ambiguous: the rule
+    fires only on statically-certain evidence."""
+    found: Dict[str, List[Optional[Tuple[str, ...]]]] = {}
+    bind_counts: Dict[str, int] = {}
+    # a function parameter is a binding too: `def f(mesh=None): if mesh is
+    # None: mesh = Mesh(...)` may receive a DIFFERENT mesh from the caller
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = scope.args
+        for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            bind_counts[arg.arg] = bind_counts.get(arg.arg, 0) + 1
+    for stmt in _scope_walk(scope):
+        for bound in _direct_bindings(stmt):
+            bind_counts[bound] = bind_counts.get(bound, 0) + 1
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)):
+            continue
+        if mod.resolve(stmt.value.func) not in _MESH_CTORS:
+            continue
+        call = stmt.value
+        axes_node = None
+        if len(call.args) >= 2:
+            axes_node = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                axes_node = kw.value
+        axes = _axis_names(axes_node) if axes_node is not None else None
+        found.setdefault(stmt.targets[0].id, []).append(axes)
+    return {
+        name: binds[0]
+        for name, binds in found.items()
+        if len(binds) == 1 and binds[0] is not None
+        and bind_counts.get(name, 0) == 1
+    }
+
+
+def _spec_axes(call: ast.Call) -> List[Tuple[str, ast.AST]]:
+    """(axis name, node) for every literal string axis in a
+    ``PartitionSpec(...)`` call — including ``("a", "b")`` tuple entries
+    that shard one dimension over two mesh axes."""
+    axes = []
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            axes.append((arg.value, arg))
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            for elt in arg.elts:
+                if (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    axes.append((elt.value, elt))
+    return axes
+
+
+class MeshAxisMismatch:
+    code = "JG013"
+    name = "mesh-axis-mismatch"
+    summary = "sharding spec names axes the paired mesh does not have"
+
+    def _check_spec(self, mod, spec_call: ast.Call,
+                    mesh_axes: Tuple[str, ...], where: str):
+        used: Dict[str, ast.AST] = {}
+        for axis, node in _spec_axes(spec_call):
+            if axis not in mesh_axes:
+                yield mod.finding(
+                    self.code,
+                    f"{where} names axis {axis!r} but the mesh's axes are "
+                    f"{tuple(mesh_axes)!r} — jax will reject this sharding "
+                    f"when it is first used, at run time on the chip; "
+                    f"rename the axis or fix the mesh",
+                    spec_call,
+                ), spec_call
+            elif axis in used:
+                yield mod.finding(
+                    self.code,
+                    f"{where} uses mesh axis {axis!r} for two dimensions — "
+                    f"an axis can shard at most one dimension of one value",
+                    spec_call,
+                ), spec_call
+            else:
+                used[axis] = node
+
+    def _spec_calls(self, mod, node: ast.AST) -> List[ast.Call]:
+        """Every PartitionSpec(...) call inside ``node`` (covers a lone
+        spec, tuples of specs, and nested spec structures)."""
+        return [
+            n for n in ast.walk(node)
+            if isinstance(n, ast.Call)
+            and mod.resolve(n.func) in _PSPEC
+        ]
+
+    def check(self, mod):
+        for scope in _common.iter_scopes(mod.tree):
+            meshes = _mesh_bindings(scope, mod)
+            if not meshes:
+                continue
+            for node in _scope_walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = mod.resolve(node.func)
+                if resolved in _NAMED_SHARDING:
+                    if not (node.args
+                            and isinstance(node.args[0], ast.Name)
+                            and node.args[0].id in meshes):
+                        continue
+                    axes = meshes[node.args[0].id]
+                    for spec_arg in node.args[1:]:
+                        for spec in self._spec_calls(mod, spec_arg):
+                            yield from self._check_spec(
+                                mod, spec, axes, "NamedSharding spec")
+                elif resolved in _SHARD_MAP:
+                    # signature: shard_map(f, mesh, in_specs, out_specs) —
+                    # every argument may be positional or keyword
+                    mesh_node = None
+                    spec_nodes = []
+                    if len(node.args) >= 2:
+                        mesh_node = node.args[1]
+                    if len(node.args) >= 3:
+                        spec_nodes.append(("in_specs", node.args[2]))
+                    if len(node.args) >= 4:
+                        spec_nodes.append(("out_specs", node.args[3]))
+                    for kw in node.keywords:
+                        if kw.arg == "mesh":
+                            mesh_node = kw.value
+                        elif kw.arg in ("in_specs", "out_specs"):
+                            spec_nodes.append((kw.arg, kw.value))
+                    if not (isinstance(mesh_node, ast.Name)
+                            and mesh_node.id in meshes):
+                        continue
+                    axes = meshes[mesh_node.id]
+                    for label, spec_node in spec_nodes:
+                        for spec in self._spec_calls(mod, spec_node):
+                            yield from self._check_spec(
+                                mod, spec, axes, f"shard_map {label} spec")

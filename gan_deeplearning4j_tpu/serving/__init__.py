@@ -8,13 +8,18 @@ request types (sample-from-z, classify-image, extract-discriminator-
 features) through one dynamic micro-batcher:
 
 - :mod:`.engine` — restores ``ComputationGraph``s from checkpoint zips,
-  AOT-compiles one executable per (request kind, padded batch bucket) so
-  arbitrary request sizes never trigger a fresh XLA compile, and pins the
-  weights on device once;
+  AOT-compiles one executable per (request kind, padded batch bucket,
+  replica) so arbitrary request sizes never trigger a fresh XLA compile
+  (eager warmup makes that true from the first request), pins the weights
+  on every replica once, assembles batches through pinned staging buffers
+  (no per-call pad alloc/concat), and routes flushes across replicas —
+  with a mesh-sharded bulk lane for oversized single-caller batches;
 - :mod:`.batcher` — a queue-based micro-batcher with max-latency / max-batch
-  triggers, per-request deadlines, and backpressure (bounded queue that
-  sheds with an explicit "overloaded" result instead of growing without
-  bound);
+  triggers, continuous-batching scheduling (hold for fullness while the
+  device is busy), a bounded two-stage dispatch/completion pipeline that
+  overlaps host assembly with device execution, per-request deadlines, and
+  backpressure (bounded queue that sheds with an explicit "overloaded"
+  result instead of growing without bound);
 - :mod:`.service` — the in-process API plus a stdlib-only HTTP JSON
   endpoint with ``/healthz`` and ``/metrics``;
 - ``python -m gan_deeplearning4j_tpu.serving`` — the server CLI.

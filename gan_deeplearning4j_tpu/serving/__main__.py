@@ -53,19 +53,44 @@ def main(argv=None) -> int:
                    help="bound on queued requests before shedding")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="default per-request deadline (seconds)")
+    p.add_argument("--replicas", default="all",
+                   help="devices to route batches across: an int, or 'all' "
+                        "for every local device (default)")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="bound on dispatched-but-unfinished flushes "
+                        "(default: 2 per replica)")
+    p.add_argument("--warmup", choices=("eager", "sync", "off"),
+                   default="eager",
+                   help="'eager' compiles the ladder on a background thread "
+                        "(serve immediately, /healthz reports 'warming'); "
+                        "'sync' blocks startup until warm; 'off' compiles "
+                        "lazily (first request per bucket pays it)")
+    p.add_argument("--compilation-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile cache dir so process "
+                        "restarts reuse AOT artifacts (default: "
+                        "$GDT_COMPILATION_CACHE / repo .jax_cache policy)")
     args = p.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
+    from gan_deeplearning4j_tpu.runtime.environment import enable_compilation_cache
+
+    cache_dir = enable_compilation_cache(args.compilation_cache)
+    if cache_dir:
+        logging.getLogger(__name__).info("compilation cache: %s", cache_dir)
+    replicas = None if args.replicas == "all" else int(args.replicas)
     if args.bundle is not None:
-        engine = ServingEngine.from_bundle(args.bundle, buckets=args.buckets)
+        engine = ServingEngine.from_bundle(
+            args.bundle, buckets=args.buckets, replicas=replicas
+        )
     elif args.generator or args.classifier:
         engine = ServingEngine.from_checkpoints(
             generator=args.generator,
             classifier=args.classifier,
             buckets=args.buckets,
             feature_vertex=args.feature_vertex,
+            replicas=replicas,
         )
     else:
         p.error("need --bundle or --generator/--classifier")
@@ -75,6 +100,8 @@ def main(argv=None) -> int:
         max_latency=args.max_latency,
         max_queue=args.max_queue,
         default_timeout=args.timeout,
+        warmup={"eager": "eager", "sync": "sync", "off": False}[args.warmup],
+        pipeline_depth=args.pipeline_depth,
     )
     serve_forever(service, args.host, args.port)
     return 0

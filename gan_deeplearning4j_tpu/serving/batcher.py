@@ -7,6 +7,20 @@ two triggers — a full batch (``max_batch`` rows) or the oldest request
 aging past ``max_latency`` — the classic throughput/latency trade of
 server-side batching (*TensorFlow: a system for large-scale ML*, §4.3).
 
+Execution is a TWO-STAGE PIPELINE (the continuous-batching shape of the
+serving literature — Orca-style iteration-level scheduling in PAPERS.md):
+a worker thread cuts a batch and *dispatches* it (host staging + async
+device launch via ``engine.dispatch``), and a completer thread *finalizes*
+it (blocks on the device, scatters rows back to callers). Because XLA
+dispatch is asynchronous, host assembly of batch N+1 overlaps device
+execution of batch N. The in-flight window is bounded
+(``pipeline_depth``): the worker will not cut a new batch while the window
+is full, so requests keep queueing — which deepens coalescing exactly when
+the device is the bottleneck — and device work is never launched for more
+flushes than the window allows. With ``pipeline_depth=1`` the pipeline
+degenerates to strictly serial flushes (the pre-pipeline behavior); that
+is the default for plain ``run_fn`` engines, which have no async seam.
+
 Backpressure is explicit, not emergent: the queue is bounded, and a submit
 against a full queue returns an ``overloaded`` result IMMEDIATELY instead
 of blocking or growing the queue without bound — under overload a serving
@@ -15,8 +29,11 @@ within its deadline is work thrown away *after* paying for it. Requests
 that expire while queued are likewise shed with ``deadline`` before any
 device work is spent on them.
 
-Pure stdlib (threading/collections): no jax import, so the batching policy
-is unit-testable with a fake engine and reusable for any ``run_fn``.
+The batching policy itself stays engine-agnostic: pass ``run_fn`` for any
+synchronous ``(kind, rows) -> rows`` callable (unit tests use fakes), or
+``engine=`` for an object with the async ``dispatch(kind, rows_list)`` /
+``finalize(handle)`` pair (``ServingEngine``, or a fake in the pipelining
+tests).
 """
 
 from __future__ import annotations
@@ -29,7 +46,10 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
-from gan_deeplearning4j_tpu.utils.profiling import percentiles
+from gan_deeplearning4j_tpu.utils.profiling import StageStats, percentiles
+
+#: pipeline stage names — the /metrics and serve_bench breakdown schema
+STAGES = ("assemble", "device", "complete")
 
 
 @dataclasses.dataclass
@@ -69,38 +89,69 @@ class _Pending:
         self.event.set()
 
 
-class MicroBatcher:
-    """Queue-based micro-batcher over a ``run_fn(kind, rows) -> rows``.
+class _Inflight:
+    """One dispatched flush traveling from worker to completer."""
 
-    One worker thread drains a bounded FIFO: it picks the oldest request's
+    __slots__ = ("riders", "handle", "total_rows")
+
+    def __init__(self, riders, handle, total_rows):
+        self.riders = riders
+        self.handle = handle
+        self.total_rows = total_rows
+
+
+class MicroBatcher:
+    """Queue-based micro-batcher over an engine or ``run_fn``.
+
+    The worker thread drains a bounded FIFO: it picks the oldest request's
     kind, coalesces every queued request of that kind (submission order,
-    up to ``max_batch`` rows), and waits out the remainder of
-    ``max_latency`` (measured from the oldest request) for stragglers when
-    the batch is not yet full. ``close()`` drains what is queued, then
-    stops the worker."""
+    up to ``max_batch`` rows), waits out the remainder of ``max_latency``
+    (measured from the oldest request) for stragglers when the batch is
+    not yet full — and only cuts a batch when the in-flight window has a
+    free slot. Dispatched flushes are finalized by the completer thread in
+    dispatch order. ``close()`` drains what is queued, then stops both."""
 
     def __init__(
         self,
-        run_fn: Callable[[str, np.ndarray], np.ndarray],
+        run_fn: Optional[Callable[[str, np.ndarray], np.ndarray]] = None,
         *,
+        engine=None,
         max_batch: int = 128,
         max_latency: float = 0.005,
         max_queue: int = 256,
         default_timeout: float = 5.0,
         max_samples: int = 65536,
+        pipeline_depth: Optional[int] = None,
     ):
+        if (run_fn is None) == (engine is None):
+            raise ValueError("pass exactly one of run_fn or engine")
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self._run_fn = run_fn
+        self._engine = engine
+        if pipeline_depth is None:
+            # an async engine says how deep its device pipe usefully runs
+            # (ServingEngine: 2/replica on accelerators, 1/replica on CPU);
+            # a synchronous run_fn has no async seam to overlap
+            pipeline_depth = (
+                getattr(engine, "default_pipeline_depth", None)
+                or 2 * getattr(engine, "replica_count", 1)
+            ) if engine else 1
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        self.pipeline_depth = pipeline_depth
         self.max_batch = max_batch
         self.max_latency = max_latency
         self.max_queue = max_queue
         self.default_timeout = default_timeout
 
         self._lock = threading.Lock()
-        self._nonempty = threading.Condition(self._lock)
+        self._cv = threading.Condition(self._lock)
         self._queue: deque = deque()
+        self._inflight: deque = deque()
+        self._window_used = 0  # cut-or-dispatched flushes not yet completed
         self._closed = False
+        self._worker_done = False
 
         # -- counters (read under the lock; exported by metrics()) ----------
         self._submitted: Dict[str, int] = defaultdict(int)
@@ -113,11 +164,17 @@ class MicroBatcher:
         self._latencies: Dict[str, deque] = defaultdict(
             lambda: deque(maxlen=max_samples)
         )
+        self._stages = StageStats(STAGES, max_samples=max_samples)
 
         self._worker = threading.Thread(
-            target=self._loop, name="micro-batcher", daemon=True
+            target=self._worker_loop, name="micro-batcher", daemon=True
+        )
+        self._completer = threading.Thread(
+            target=self._completer_loop, name="micro-batcher-complete",
+            daemon=True,
         )
         self._worker.start()
+        self._completer.start()
 
     # -- client side --------------------------------------------------------
     def submit(
@@ -149,10 +206,11 @@ class MicroBatcher:
                 self._shed_overloaded += 1
                 return ServeResult("overloaded", error="queue full")
             self._queue.append(req)
-            self._nonempty.notify()
+            self._cv.notify_all()
         # the worker sheds expired requests, so this wait is bounded; the
-        # grace covers a flush already in flight at deadline time
-        req.event.wait(timeout + self.max_latency + 1.0)
+        # grace covers flushes already in flight at deadline time — up to
+        # pipeline_depth of them can sit ahead of this request's flush
+        req.event.wait(timeout + self.max_latency + 1.0 * self.pipeline_depth)
         if req.result is None:  # worker wedged (engine hung) — still bounded
             return ServeResult("deadline", error="no result within deadline")
         return req.result
@@ -166,91 +224,222 @@ class MicroBatcher:
                     self._queue.popleft().finish(
                         ServeResult("overloaded", error="batcher is closed")
                     )
-            self._nonempty.notify()
+            self._cv.notify_all()
         self._worker.join(timeout=10.0)
+        self._completer.join(timeout=10.0)
 
     # -- worker side --------------------------------------------------------
     def _take_batch(self):
-        """Under the lock: wait for work, pick the oldest request's kind,
-        and cut a same-kind batch (≤ max_batch rows, submission order)."""
+        """Under the lock: wait for work AND a free in-flight slot, pick
+        the oldest request's kind, and cut a same-kind batch (≤ max_batch
+        rows, submission order). Reserves a window slot for the batch it
+        returns."""
         while True:
-            while not self._queue and not self._closed:
-                self._nonempty.wait()
+            while ((not self._queue or self._window_used >= self.pipeline_depth)
+                   and not self._closed):
+                self._cv.wait()
             if not self._queue:
                 return None  # closed and drained
+            if self._window_used >= self.pipeline_depth:
+                if self._closed:
+                    # still drain on close — wait for the window to free up
+                    self._cv.wait()
+                continue
             oldest = self._queue[0]
-            # not full yet and still young: give stragglers a chance
-            age = time.monotonic() - oldest.enqueued
-            if age < self.max_latency and not self._closed:
-                same = sum(
-                    r.rows.shape[0] for r in self._queue if r.kind == oldest.kind
-                )
-                if same < self.max_batch:
-                    self._nonempty.wait(timeout=self.max_latency - age)
-                    continue
+            cut_kind = oldest.kind
+            # not full yet: give stragglers a chance. Two regimes (the
+            # continuous-batching policy): while the device already has
+            # work in flight, a partial flush would only queue behind it —
+            # hold for fullness instead (each completion re-wakes this
+            # wait), but a FULL batch of ANY kind always cuts immediately
+            # (it must not stall behind a partial oldest while window
+            # slots sit free); once the device is hungry, wait out at most
+            # the remainder of max_latency and then feed it whatever is
+            # here. max_latency == 0 disables all batching delay, as
+            # before.
+            now = time.monotonic()
+            age = now - oldest.enqueued
+            if self.max_latency > 0 and not self._closed:
+                kind_rows: Dict[str, int] = defaultdict(int)
+                for r in self._queue:
+                    kind_rows[r.kind] += r.rows.shape[0]
+                if kind_rows[oldest.kind] < self.max_batch:
+                    # fairness bound: once the oldest has burned half its
+                    # deadline budget queued, its kind cuts NOW — neither
+                    # a full batch of another kind nor a busy device may
+                    # starve it further (sustained full-batch load would
+                    # otherwise hold a sparse kind's partial forever)
+                    overdue = age >= 0.5 * (oldest.deadline - oldest.enqueued)
+                    if not overdue:
+                        full = next((k for k, n in kind_rows.items()
+                                     if n >= self.max_batch), None)
+                        if full is not None:
+                            cut_kind = full
+                        elif self._window_used > 0:
+                            # device fed: hold for fullness — but shed
+                            # already-expired requests in place, so a hold
+                            # can never pin dead entries in queue slots
+                            if self._shed_expired():
+                                continue
+                            self._cv.wait(timeout=self.max_latency)
+                            continue
+                        elif age < self.max_latency:
+                            self._cv.wait(timeout=self.max_latency - age)
+                            continue
+            if oldest.rows.shape[0] > self.max_batch:
+                # a rider larger than max_batch can never coalesce: cut it
+                # ALONE, now (the engine chunks it through the top bucket).
+                # Skipping it for younger fitting riders would starve it
+                # forever under sustained same-kind traffic.
+                self._queue.popleft()
+                self._window_used += 1
+                return [oldest]
             batch, keep, total = [], deque(), 0
             for req in self._queue:
-                if req.kind == oldest.kind and total + req.rows.shape[0] <= self.max_batch:
+                if req.kind == cut_kind and total + req.rows.shape[0] <= self.max_batch:
                     batch.append(req)
                     total += req.rows.shape[0]
                 else:
                     keep.append(req)
-            if not batch:  # oldest alone exceeds max_batch — take it anyway
-                batch.append(oldest)
-                keep = deque(r for r in self._queue if r is not oldest)
+            if not batch:
+                # cut_kind's first rider alone exceeds max_batch: cut THAT
+                # rider by itself (the engine chunks it) rather than
+                # falling back to the held partial oldest of another kind
+                target = (oldest if cut_kind == oldest.kind else
+                          next(r for r in self._queue if r.kind == cut_kind))
+                batch.append(target)
+                keep = deque(r for r in self._queue if r is not target)
             self._queue = keep
+            self._window_used += 1
             return batch
 
-    def _loop(self) -> None:
+    def _shed_expired(self) -> bool:
+        """Under the lock: finish + remove queued requests already past
+        their deadline (no device work was spent on them). True when
+        anything was shed — the caller re-examines the queue."""
+        now = time.monotonic()
+        if not any(now > r.deadline for r in self._queue):
+            return False
+        keep: deque = deque()
+        for req in self._queue:
+            if now > req.deadline:
+                self._shed_deadline += 1
+                req.finish(
+                    ServeResult("deadline", error="expired while queued")
+                )
+            else:
+                keep.append(req)
+        self._queue = keep
+        return True
+
+    def _release_slot(self) -> None:
+        with self._lock:
+            self._window_used -= 1
+            self._cv.notify_all()
+
+    def _dispatch(self, kind: str, rows_list):
+        """Stage-A half of one flush. For an async engine this stages,
+        transfers, and launches without waiting; for a plain run_fn the
+        handle defers ALL work to finalize (stage B), keeping the worker
+        free to keep cutting batches."""
+        if self._engine is not None:
+            return self._engine.dispatch(kind, rows_list)
+        return (kind, rows_list)
+
+    def _finalize(self, handle) -> np.ndarray:
+        if self._engine is not None:
+            return np.asarray(self._engine.finalize(handle))
+        kind, rows_list = handle
+        # the concatenate stays INSIDE the stage-B guard: a width-mismatched
+        # rider must error its own batch, not kill the completer thread
+        rows = rows_list[0] if len(rows_list) == 1 else np.concatenate(rows_list)
+        return np.asarray(self._run_fn(kind, rows))
+
+    def _worker_loop(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    batch = self._take_batch()
+                if batch is None:
+                    return
+                now = time.monotonic()
+                live = []
+                for req in batch:
+                    if now > req.deadline:
+                        with self._lock:
+                            self._shed_deadline += 1
+                        req.finish(
+                            ServeResult("deadline", error="expired while queued")
+                        )
+                    else:
+                        live.append(req)
+                if not live:
+                    self._release_slot()
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    handle = self._dispatch(
+                        live[0].kind, [r.rows for r in live]
+                    )
+                except Exception as exc:  # dispatch failure -> riders error
+                    with self._lock:
+                        self._errors += len(live)
+                    for req in live:
+                        req.finish(ServeResult(
+                            "error", error=f"{type(exc).__name__}: {exc}"))
+                    self._release_slot()
+                    continue
+                total = sum(r.rows.shape[0] for r in live)
+                with self._lock:
+                    self._stages.add("assemble", time.perf_counter() - t0)
+                    self._inflight.append(_Inflight(live, handle, total))
+                    self._cv.notify_all()
+        finally:
+            with self._lock:
+                self._worker_done = True
+                self._cv.notify_all()
+
+    def _completer_loop(self) -> None:
         while True:
             with self._lock:
-                batch = self._take_batch()
-            if batch is None:
-                return
-            now = time.monotonic()
-            live = []
-            for req in batch:
-                if now > req.deadline:
-                    with self._lock:
-                        self._shed_deadline += 1
-                    req.finish(
-                        ServeResult("deadline", error="expired while queued")
-                    )
-                else:
-                    live.append(req)
-            if not live:
-                continue
+                while not self._inflight and not self._worker_done:
+                    self._cv.wait()
+                if not self._inflight:
+                    return  # worker exited and everything is finalized
+                ent = self._inflight.popleft()
+            t0 = time.perf_counter()
             try:
-                # the concatenate stays INSIDE the guard: a width-mismatched
-                # rider must error its own batch, not kill the worker thread
-                rows = (
-                    live[0].rows
-                    if len(live) == 1
-                    else np.concatenate([r.rows for r in live])
-                )
-                out = np.asarray(self._run_fn(live[0].kind, rows))
+                out = self._finalize(ent.handle)
             except Exception as exc:  # engine failure -> every rider errors
                 with self._lock:
-                    self._errors += len(live)
-                for req in live:
-                    req.finish(ServeResult("error", error=f"{type(exc).__name__}: {exc}"))
+                    self._errors += len(ent.riders)
+                for req in ent.riders:
+                    req.finish(ServeResult(
+                        "error", error=f"{type(exc).__name__}: {exc}"))
+                self._release_slot()
                 continue
-            with self._lock:
-                self._flushes += 1
-                self._occupancy[rows.shape[0]] += 1
+            t1 = time.perf_counter()
             offset = 0
-            for req in live:
+            for req in ent.riders:
                 n = req.rows.shape[0]
                 req.finish(ServeResult("ok", data=out[offset:offset + n]))
                 offset += n
-                with self._lock:
+            t2 = time.perf_counter()
+            with self._lock:
+                self._stages.add("device", t1 - t0)
+                self._stages.add("complete", t2 - t1)
+                self._flushes += 1
+                self._occupancy[ent.total_rows] += 1
+                for req in ent.riders:
                     self._completed[req.kind] += 1
                     self._latencies[req.kind].append(req.result.latency_s)
+            self._release_slot()
 
     # -- observability ------------------------------------------------------
     def metrics(self) -> dict:
-        """Counter snapshot + latency percentiles + occupancy histogram —
-        the /metrics payload schema (docs/SERVING.md)."""
+        """Counter snapshot + latency percentiles + occupancy histogram +
+        per-stage pipeline breakdown — the /metrics payload schema
+        (docs/SERVING.md)."""
         with self._lock:
             lat = {
                 kind: {
@@ -268,4 +457,11 @@ class MicroBatcher:
                 "queue_depth": len(self._queue),
                 "batch_occupancy": {str(k): v for k, v in sorted(self._occupancy.items())},
                 "latency_ms": lat,
+                "pipeline": {
+                    "depth": self.pipeline_depth,
+                    "in_flight": self._window_used,
+                    "mode": "engine" if self._engine is not None else "run_fn",
+                    "stage_ms": self._stages.summary_ms(),
+                    "stage_occupancy": self._stages.occupancy(),
+                },
             }

@@ -1,15 +1,41 @@
 """ServingEngine — checkpoint-backed executor with a padded bucket ladder.
 
 Loads serializer checkpoints (``utils/serializer.read_model`` — topology +
-params, no training code needed), pins the weights on device ONCE, and
-pre-compiles one XLA executable per (request kind, batch bucket) via jit's
-AOT path (``lower().compile()``). Requests are padded up to the smallest
-bucket and sliced back, so an arbitrary request size NEVER triggers a fresh
-compile at serve time — with free-running shapes every new batch size would
-stall a request tail for seconds of XLA compilation (the recompilation
-hazard jaxlint JG004 polices in training code, recurring here as a serving
-tail-latency cliff). Compiles are counted per kind; the serve bench asserts
-the count stays ≤ the ladder size.
+params, no training code needed), pins the weights on device ONCE per
+replica, and pre-compiles one XLA executable per (request kind, batch
+bucket, replica) via jit's AOT path (``lower().compile()``). Requests are
+padded up to the smallest bucket and sliced back, so an arbitrary request
+size NEVER triggers a fresh compile at serve time: ``warmup()`` compiles
+the full ladder before the first request (the service does this at
+construction, eagerly in a background thread if asked), and
+``serve_compile_counts`` proves the count of post-warmup compiles stays 0
+— with free-running shapes every new batch size would stall a request
+tail for seconds of XLA compilation (the recompilation hazard jaxlint
+JG004 polices in training code, recurring here as a serving tail-latency
+cliff).
+
+The serve fast path (docs/SERVING.md "Fast path"):
+
+- **staged assembly** — padding is not a per-call ``np.zeros`` +
+  ``np.concatenate``: each (kind, bucket) keeps a small pool of reusable
+  pinned staging buffers whose pad tail is maintained at zero via a
+  high-water mark, so assembling a flush is one memcpy per rider and at
+  most one memset of the shrink delta, then a single ``device_put``.
+  (True device-side padding of an ``(n, width)`` transfer would need an
+  executable specialized per ``n`` — unbounded compiles, the exact hazard
+  the ladder exists to kill — so the pad lives in the pinned host buffer
+  and the device sees only bucket shapes.)
+- **dispatch/finalize split** — ``dispatch()`` stages, transfers, and
+  launches the AOT executable without waiting for the result (XLA
+  dispatch is async); ``finalize()`` blocks, slices the padding off, and
+  recycles the staging buffer. The micro-batcher runs the two halves on
+  different threads so host assembly of batch N+1 overlaps device
+  execution of batch N.
+- **multi-replica routing** — with ``replicas > 1`` every (kind, bucket)
+  executable is compiled once per replica device and each flush is routed
+  to the least-loaded replica; oversized single-caller batches can
+  additionally ride one mesh-sharded bulk executable that splits a
+  ``top_bucket × replicas`` slab across all replicas at once.
 
 Request kinds (SURVEY §0 — the trained artifacts, not the loop):
 
@@ -24,20 +50,60 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
+#: staging buffers kept per (kind, bucket) — enough for a deep pipeline
+#: window without ever allocating on the hot path
+_POOL_LIMIT = 4
+
+
+class _StagingBuf:
+    """A reusable pinned host buffer of bucket shape whose tail is kept at
+    zero. ``high_water`` is the largest row count ever written: rows past
+    it are known-zero, so a smaller flush only memsets the shrink delta
+    ``[n, high_water)`` instead of the whole pad region."""
+
+    __slots__ = ("arr", "high_water")
+
+    def __init__(self, bucket: int, width: int):
+        self.arr = np.zeros((bucket, width), np.float32)
+        self.high_water = 0
+
+    def reset_tail(self, n: int) -> None:
+        if self.high_water > n:
+            self.arr[n:self.high_water] = 0.0
+        # rows past n are now zero either way (freshly zeroed above, or
+        # zero since construction) — n IS the new high water; a monotone
+        # max would re-memset the full pad region on every small flush
+        # after one large one
+        self.high_water = n
+
+
+class _Flight:
+    """One dispatched flush: the in-flight device computation plus what
+    ``finalize`` needs to slice, recycle, and account it."""
+
+    __slots__ = ("kind", "total", "parts")
+
+    def __init__(self, kind: str, total: int, parts: list):
+        self.kind = kind
+        self.total = total
+        # parts: (device_out, n_real_rows, staging_buf_or_None, replica_or_None)
+        self.parts = parts
+
 
 class ServingEngine:
-    """Model-backed executor: ``run(kind, rows) -> rows``.
+    """Model-backed executor: ``run(kind, rows) -> rows``, or the async
+    pair ``dispatch(kind, rows_list) -> flight`` / ``finalize(flight)``.
 
     ``models`` maps role ("generator"/"classifier") to a loaded
     ``(ComputationGraph, params)`` pair. Thread-safe: AOT executables are
-    compiled under a lock (the batcher worker is single-threaded, but the
-    in-process API may be driven from many threads)."""
+    compiled under a lock (warmup may race the serve path), and the
+    staging pool is checked out/in under the same lock."""
 
     def __init__(
         self,
@@ -45,6 +111,7 @@ class ServingEngine:
         *,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         feature_vertex: Optional[str] = None,
+        replicas: Optional[int] = 1,
     ):
         import jax
 
@@ -55,10 +122,22 @@ class ServingEngine:
             raise ValueError(f"invalid bucket ladder {buckets!r}")
         self.buckets = buckets
         self.feature_vertex = feature_vertex
-        # weights cross to the device once, here — never per request
+
+        devices = jax.local_devices()
+        if replicas is None:
+            replicas = len(devices)
+        if not 1 <= replicas <= len(devices):
+            raise ValueError(
+                f"replicas={replicas} but {len(devices)} local device(s) "
+                f"are available"
+            )
+        self._devices = tuple(devices[:replicas])
+
+        # weights cross to each replica once, here — never per request
         self._graphs = {role: graph for role, (graph, _) in models.items()}
         self._params = {
-            role: jax.device_put(params) for role, (_, params) in models.items()
+            role: [jax.device_put(params, d) for d in self._devices]
+            for role, (_, params) in models.items()
         }
 
         self._kinds: Dict[str, Tuple[str, object]] = {}  # kind -> (role, fn)
@@ -93,9 +172,24 @@ class ServingEngine:
             kind: self._graphs[role].input_types[0].features
             for kind, (role, _) in self._kinds.items()
         }
-        self._compiled: Dict[Tuple[str, int], object] = {}
+        self._compiled: Dict[Tuple[str, int, int], object] = {}
+        self._bulk: Dict[str, object] = {}  # kind -> mesh-sharded executable
+        self._params_mesh: Dict[str, object] = {}
+        self._batch_sharding = None
         self._compile_counts: Dict[str, int] = {k: 0 for k in self._kinds}
+        self._serve_compiles: Dict[str, int] = {k: 0 for k in self._kinds}
+        self._staging: Dict[Tuple[str, int], List[_StagingBuf]] = {}
+        self._outstanding = [0] * replicas  # in-flight flushes per replica
+        self._dispatches = [0] * replicas
+        self._rr = 0  # round-robin tiebreak cursor
+        self._warmed = False
+        self._warm_thread: Optional[threading.Thread] = None
+        self._warm_error: Optional[BaseException] = None
+        # _lock: cheap shared state (staging pool, routing, counters);
+        # _compile_lock: serializes XLA compiles only, so warmup compiling
+        # the ladder never blocks the cached-executable serve path
         self._lock = threading.Lock()
+        self._compile_lock = threading.Lock()
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -106,6 +200,7 @@ class ServingEngine:
         *,
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         feature_vertex: Optional[str] = None,
+        replicas: Optional[int] = 1,
     ) -> "ServingEngine":
         """Restore from serializer checkpoint zips. Updater state is never
         loaded — a serving replica has no optimizer."""
@@ -117,11 +212,13 @@ class ServingEngine:
                 continue
             graph, params, _, _ = read_model(path, load_updater=False)
             models[role] = (graph, params)
-        return cls(models, buckets=buckets, feature_vertex=feature_vertex)
+        return cls(models, buckets=buckets, feature_vertex=feature_vertex,
+                   replicas=replicas)
 
     @classmethod
     def from_bundle(
-        cls, directory: str, *, buckets: Sequence[int] = DEFAULT_BUCKETS
+        cls, directory: str, *, buckets: Sequence[int] = DEFAULT_BUCKETS,
+        replicas: Optional[int] = 1,
     ) -> "ServingEngine":
         """Load a ``serving.json`` bundle published by
         ``GanExperiment.publish_for_serving``."""
@@ -142,6 +239,7 @@ class ServingEngine:
             classifier=_path("classifier"),
             buckets=buckets,
             feature_vertex=manifest.get("feature_vertex"),
+            replicas=replicas,
         )
 
     # -- introspection ------------------------------------------------------
@@ -153,11 +251,76 @@ class ServingEngine:
         return self._in_width[kind]
 
     @property
+    def replica_count(self) -> int:
+        return len(self._devices)
+
+    @property
+    def default_pipeline_depth(self) -> int:
+        """In-flight flush window the batcher uses unless overridden. On a
+        real accelerator, two per replica: one executing plus one queued
+        behind it so the device never waits on host assembly. On the CPU
+        backend the "device" shares the host's cores — overlapping flushes
+        just thrashes them — so one per replica."""
+        per_replica = 1 if self._devices[0].platform == "cpu" else 2
+        return per_replica * len(self._devices)
+
+    @property
     def compile_counts(self) -> Dict[str, int]:
-        """Distinct XLA compiles per kind so far — the bench's ladder
-        invariant (each must stay ≤ ``len(self.buckets)``)."""
+        """Distinct XLA compiles per kind so far (warmup + serve-time) —
+        each must stay ≤ ``expected_max_compiles``."""
         with self._lock:
             return dict(self._compile_counts)
+
+    @property
+    def serve_compile_counts(self) -> Dict[str, int]:
+        """Compiles that happened AFTER warmup completed — the fast-path
+        contract is that this stays 0 per kind (every request rides a
+        pre-compiled bucket executable)."""
+        with self._lock:
+            return dict(self._serve_compiles)
+
+    @property
+    def expected_max_compiles(self) -> int:
+        """The bounded-compile invariant: per kind, at most one executable
+        per (bucket, replica) plus one mesh bulk executable when more than
+        one replica is routed."""
+        r = len(self._devices)
+        return len(self.buckets) * r + (1 if r > 1 else 0)
+
+    @property
+    def warming(self) -> bool:
+        """True while a background warmup is still compiling the ladder."""
+        t = self._warm_thread
+        return t is not None and t.is_alive()
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    @property
+    def warm_failed(self) -> bool:
+        """True when a warmup attempt raised — /healthz must surface this
+        (the ladder is NOT compiled; lazy serve-time compiles would
+        otherwise masquerade as a healthy replica)."""
+        return self._warm_error is not None
+
+    def stats(self) -> dict:
+        """Engine-side observability merged into the service /metrics."""
+        with self._lock:
+            per_replica = [0] * len(self._devices)
+            for (_, _, r) in self._compiled:
+                per_replica[r] += 1
+            return {
+                "replicas": len(self._devices),
+                "replica_dispatches": list(self._dispatches),
+                "replica_in_flight": list(self._outstanding),
+                "compile_counts": dict(self._compile_counts),
+                "serve_compile_counts": dict(self._serve_compiles),
+                "compiled_per_replica": per_replica,
+                "warmup": "warm" if self._warmed else (
+                    "warming" if self.warming else (
+                        "failed" if self._warm_error is not None else "cold")),
+            }
 
     # -- compilation --------------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -166,55 +329,294 @@ class ServingEngine:
                 return b
         return self.buckets[-1]
 
-    def _executable(self, kind: str, bucket: int):
-        key = (kind, bucket)
+    def _executable(self, kind: str, bucket: int, replica: int = 0):
+        key = (kind, bucket, replica)
         exe = self._compiled.get(key)
         if exe is not None:
             return exe
-        with self._lock:
+        # compiles serialize on their OWN lock: ``self._lock`` guards only
+        # cheap state (pool, routing, counters), so a multi-second XLA
+        # compile — eager warmup working through the ladder — never stalls
+        # requests whose executables are already cached
+        with self._compile_lock:
             exe = self._compiled.get(key)
             if exe is not None:
                 return exe
             import jax
+            from jax.sharding import SingleDeviceSharding
 
             role, fn = self._kinds[kind]
             spec = jax.ShapeDtypeStruct(
-                (bucket, self._in_width[kind]), np.float32
+                (bucket, self._in_width[kind]), np.float32,
+                sharding=SingleDeviceSharding(self._devices[replica]),
             )
-            # AOT: lower for the exact padded shape and keep the executable;
-            # serve-time calls can then never re-trace or re-compile
-            exe = jax.jit(fn).lower(self._params[role], spec).compile()
-            self._compiled[key] = exe
-            self._compile_counts[kind] += 1
+            # AOT: lower for the exact padded shape on the exact replica
+            # device and keep the executable; serve-time calls can then
+            # never re-trace or re-compile
+            exe = jax.jit(fn).lower(
+                self._params[role][replica], spec
+            ).compile()
+            with self._lock:
+                self._compiled[key] = exe
+                self._compile_counts[kind] += 1
+                # a compile after warmup finished — OR after it failed —
+                # is a serve-time compile: some request is paying for it
+                if self._warmed or self._warm_error is not None:
+                    self._serve_compiles[kind] += 1
             return exe
 
-    def warmup(self) -> Dict[str, int]:
-        """Compile the FULL ladder up front (cold-start cost paid before the
-        first request, not by it). Returns the per-kind compile counts."""
-        for kind in self._kinds:
-            for b in self.buckets:
-                self._executable(kind, b)
+    def _bulk_executable(self, kind: str):
+        """One mesh-sharded executable per kind that splits a
+        ``top_bucket × replicas`` slab evenly across every replica — the
+        bulk lane for oversized single-caller batches (offline scoring).
+        Compiled at warmup only; returns None for single-replica engines."""
+        if len(self._devices) < 2:
+            return None
+        exe = self._bulk.get(kind)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._bulk.get(kind)
+            if exe is not None:
+                return exe
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            mesh = Mesh(np.asarray(self._devices), ("replica",))
+            replicated = NamedSharding(mesh, PartitionSpec())
+            batched = NamedSharding(mesh, PartitionSpec("replica"))
+            role, fn = self._kinds[kind]
+            if role not in self._params_mesh:
+                self._params_mesh[role] = jax.device_put(
+                    self._params[role][0], replicated
+                )
+            self._batch_sharding = batched
+            slab = len(self._devices) * self.buckets[-1]
+            spec = jax.ShapeDtypeStruct(
+                (slab, self._in_width[kind]), np.float32, sharding=batched
+            )
+            exe = jax.jit(fn).lower(self._params_mesh[role], spec).compile()
+            with self._lock:
+                self._bulk[kind] = exe
+                self._compile_counts[kind] += 1
+                if self._warmed or self._warm_error is not None:
+                    self._serve_compiles[kind] += 1
+            return exe
+
+    def warmup(self, background: bool = False):
+        """Compile the FULL ladder — every (kind, bucket, replica), plus
+        the bulk lane when multi-replica — so no request ever pays a
+        serve-time compile. ``background=True`` runs the compiles on a
+        daemon thread (``warming`` is True until it finishes; ``/healthz``
+        reports it); otherwise blocks and returns per-kind compile counts."""
+        if background:
+            with self._lock:
+                if self._warm_thread is not None and self._warm_thread.is_alive():
+                    return self._warm_thread
+                t = threading.Thread(
+                    target=self._warm_all_quiet, name="engine-warmup",
+                    daemon=True,
+                )
+                self._warm_thread = t
+            t.start()
+            return t
+        self._warm_all()
         return self.compile_counts
 
+    def _warm_all_quiet(self) -> None:
+        """Background-thread wrapper: the failure is STORED (surfaced via
+        ``wait_warm``/``warm_failed``/healthz), not re-raised into an
+        unhandled-thread-exception hook."""
+        try:
+            self._warm_all()
+        except BaseException:
+            pass
+
+    def _warm_all(self) -> None:
+        try:
+            for kind in self._kinds:
+                for r in range(len(self._devices)):
+                    for b in self.buckets:
+                        self._executable(kind, b, r)
+                self._bulk_executable(kind)
+            self._warm_error = None
+        except BaseException as exc:  # surfaced by wait_warm/healthz
+            self._warm_error = exc
+            raise
+        finally:
+            self._warmed = self._warm_error is None
+
+    def wait_warm(self, timeout: Optional[float] = None) -> bool:
+        """Block until a background warmup finishes. True when the engine
+        is warm; raises the warmup's error if compiling failed."""
+        t = self._warm_thread
+        if t is not None:
+            t.join(timeout)
+        if self._warm_error is not None:
+            raise RuntimeError("engine warmup failed") from self._warm_error
+        return self._warmed
+
+    # -- staging pool -------------------------------------------------------
+    def _checkout(self, kind: str, bucket: int) -> _StagingBuf:
+        key = (kind, bucket)
+        with self._lock:
+            pool = self._staging.get(key)
+            if pool:
+                return pool.pop()
+        return _StagingBuf(bucket, self._in_width[kind])
+
+    def _checkin(self, kind: str, buf: _StagingBuf) -> None:
+        key = (kind, buf.arr.shape[0])
+        with self._lock:
+            pool = self._staging.setdefault(key, [])
+            if len(pool) < _POOL_LIMIT:
+                pool.append(buf)
+
+    def _pick_replica(self) -> int:
+        with self._lock:
+            load = min(self._outstanding)
+            candidates = [i for i, o in enumerate(self._outstanding)
+                          if o == load]
+            r = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            self._outstanding[r] += 1
+            self._dispatches[r] += 1
+            return r
+
     # -- execution ----------------------------------------------------------
-    def run(self, kind: str, rows: np.ndarray) -> np.ndarray:
-        """Execute one batch: pad to the bucket, run the AOT executable,
-        slice the padding back off. Batches larger than the top bucket are
-        served in top-bucket chunks (the batcher's max_batch normally
-        prevents that, but the engine stays correct standalone)."""
+    def _validate(self, kind: str, rows_list) -> int:
         if kind not in self._kinds:
             raise KeyError(
                 f"unknown request kind {kind!r}; serving {sorted(self._kinds)}"
             )
-        rows = np.asarray(rows, dtype=np.float32)
-        if (rows.ndim != 2 or rows.shape[0] < 1
-                or rows.shape[1] != self._in_width[kind]):
-            raise ValueError(
-                f"{kind}: expected (n >= 1, {self._in_width[kind]}) rows, "
-                f"got {rows.shape}"
-            )
+        width = self._in_width[kind]
+        total = 0
+        for rows in rows_list:
+            if (rows.ndim != 2 or rows.shape[0] < 1
+                    or rows.shape[1] != width):
+                raise ValueError(
+                    f"{kind}: expected (n >= 1, {width}) rows, "
+                    f"got {rows.shape}"
+                )
+            total += rows.shape[0]
+        if not rows_list:
+            raise ValueError(f"{kind}: empty batch")
+        return total
+
+    def dispatch(self, kind: str, rows_list: Sequence[np.ndarray]) -> _Flight:
+        """Assemble the riders into bucket-shaped staged buffers and launch
+        the AOT executables WITHOUT waiting for results (async dispatch —
+        the caller overlaps host work with device execution and collects
+        via :meth:`finalize`). Rider arrays are copied once each, directly
+        into the pinned staging buffer — no intermediate concat."""
+        rows_list = [np.asarray(r, dtype=np.float32) for r in rows_list]
+        total = self._validate(kind, rows_list)
+        top = self.buckets[-1]
         role, _ = self._kinds[kind]
-        params = self._params[role]
+
+        parts = []
+        try:
+            return self._dispatch_chunks(
+                kind, role, rows_list, total, top, parts)
+        except BaseException:
+            # a failed later chunk must release EVERY earlier chunk's
+            # buffer + replica reservation, or routing counts phantom load
+            for _, _, buf, r in parts:
+                self._release(kind, buf, r)
+            raise
+
+    def _dispatch_chunks(self, kind, role, rows_list, total, top,
+                         parts) -> "_Flight":
+        import jax
+
+        # rider cursor: (index into rows_list, row offset within that rider)
+        ri, roff = 0, 0
+        remaining = total
+        while remaining > 0:
+            # bulk lane: a full replicas×top slab from ONE rider splits
+            # across every replica in a single mesh-sharded call
+            slab = len(self._devices) * top
+            if (remaining >= slab and len(self._devices) > 1
+                    and roff + slab <= rows_list[ri].shape[0]):
+                exe = self._bulk_executable(kind)
+                if exe is not None:
+                    chunk = rows_list[ri][roff:roff + slab]
+                    dev = jax.device_put(chunk, self._batch_sharding)
+                    parts.append((exe(self._params_mesh[role], dev),
+                                  slab, None, None))
+                    roff += slab
+                    remaining -= slab
+                    if roff == rows_list[ri].shape[0]:
+                        ri, roff = ri + 1, 0
+                    continue
+            n = min(top, remaining)
+            bucket = self._bucket_for(n)
+            buf = self._checkout(kind, bucket)
+            filled = 0
+            while filled < n:
+                rider = rows_list[ri]
+                take = min(n - filled, rider.shape[0] - roff)
+                buf.arr[filled:filled + take] = rider[roff:roff + take]
+                filled += take
+                roff += take
+                if roff == rider.shape[0]:
+                    ri, roff = ri + 1, 0
+            buf.reset_tail(n)
+            r = self._pick_replica()
+            try:
+                dev = jax.device_put(buf.arr, self._devices[r])
+                out = self._executable(kind, bucket, r)(
+                    self._params[role][r], dev
+                )
+            except BaseException:
+                # undo the reservation or least-loaded routing (and
+                # /metrics in-flight) would count phantom load forever
+                self._release(kind, buf, r)
+                raise
+            parts.append((out, n, buf, r))
+            remaining -= n
+        return _Flight(kind, total, parts)
+
+    def _release(self, kind: str, buf: Optional[_StagingBuf],
+                 r: Optional[int]) -> None:
+        if buf is not None:
+            self._checkin(kind, buf)
+        if r is not None:
+            with self._lock:
+                self._outstanding[r] -= 1
+
+    def finalize(self, flight: _Flight) -> np.ndarray:
+        """Block until the flight's device work is done, slice the padding
+        off, recycle the staging buffers, and return the result rows.
+        Buffers and replica in-flight counts are released for EVERY part,
+        even when a device sync raises partway through."""
+        outs = []
+        parts = list(flight.parts)
+        flight.parts = []  # release exactly once, even if called twice
+        try:
+            for out, n, buf, r in parts:
+                outs.append(np.asarray(out)[:n])  # device sync + transfer
+        finally:
+            for _, _, buf, r in parts:
+                self._release(flight.kind, buf, r)
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def run(self, kind: str, rows: np.ndarray) -> np.ndarray:
+        """Execute one batch synchronously: staged assembly, AOT execute,
+        unpad. Batches larger than the top bucket are served in top-bucket
+        chunks (and, multi-replica, full slabs ride the bulk lane)."""
+        rows = np.asarray(rows, dtype=np.float32)
+        return self.finalize(self.dispatch(kind, [rows]))
+
+    def run_host(self, kind: str, rows: np.ndarray) -> np.ndarray:
+        """Reference host-assembly path (the PR 3 semantics): pad with a
+        fresh ``np.zeros`` + ``np.concatenate`` per chunk and execute on
+        replica 0. Kept as the bit-exactness oracle for the staged path
+        (tests) and the ``--legacy`` mode of ``scripts/serve_bench.py``."""
+        rows = np.asarray(rows, dtype=np.float32)
+        self._validate(kind, [rows])
+        role, _ = self._kinds[kind]
+        params = self._params[role][0]
         top = self.buckets[-1]
         outs = []
         for start in range(0, rows.shape[0], top):
@@ -225,7 +627,7 @@ class ServingEngine:
                     (bucket - chunk.shape[0], chunk.shape[1]), np.float32
                 )
                 chunk = np.concatenate([chunk, pad])
-            out = self._executable(kind, bucket)(params, chunk)
+            out = self._executable(kind, bucket, 0)(params, chunk)
             outs.append(
                 np.asarray(out)[: min(top, rows.shape[0] - start)]
             )

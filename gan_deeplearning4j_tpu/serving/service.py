@@ -36,7 +36,14 @@ _STATUS_HTTP = {"ok": 200, "overloaded": 503, "deadline": 503, "error": 500}
 class InferenceService:
     """The in-process serving API. One micro-batcher fronts the engine;
     every public call goes through it, so in-process and HTTP callers share
-    batching, deadlines, and backpressure."""
+    batching, deadlines, backpressure, and the dispatch/finalize pipeline.
+
+    ``warmup`` controls when the engine compiles its executable ladder:
+    ``True``/``"sync"`` blocks construction until warm (no request can
+    ever see a compile); ``"eager"`` compiles on a background thread —
+    the service accepts requests immediately and ``/healthz`` reports
+    ``"warming"`` until the ladder is done; ``False`` leaves compiles
+    lazy (first request per bucket pays one — only for tests/tools)."""
 
     def __init__(
         self,
@@ -46,17 +53,23 @@ class InferenceService:
         max_latency: float = 0.005,
         max_queue: int = 256,
         default_timeout: float = 5.0,
-        warmup: bool = True,
+        warmup="sync",
+        pipeline_depth: Optional[int] = None,
     ):
         self.engine = engine
-        if warmup:
+        if warmup in (True, "sync"):
             engine.warmup()
+        elif warmup in ("eager", "background"):
+            engine.warmup(background=True)
+        elif warmup not in (False, None, "off"):
+            raise ValueError(f"unknown warmup mode {warmup!r}")
         self.batcher = MicroBatcher(
-            engine.run,
+            engine=engine,
             max_batch=max_batch or engine.buckets[-1],
             max_latency=max_latency,
             max_queue=max_queue,
             default_timeout=default_timeout,
+            pipeline_depth=pipeline_depth,
         )
 
     # -- typed convenience wrappers ----------------------------------------
@@ -71,12 +84,28 @@ class InferenceService:
 
     # -- shared request handler --------------------------------------------
     def healthz(self) -> dict:
-        return {"status": "ok", "kinds": list(self.engine.kinds),
-                "buckets": list(self.engine.buckets)}
+        if self.engine.warming:
+            status = "warming"
+        elif self.engine.warm_failed:
+            # a failed background warmup must NOT look healthy: the ladder
+            # is not compiled, so requests would pay serve-time compiles
+            status = "error"
+        else:
+            status = "ok"
+        body = {
+            "status": status,
+            "kinds": list(self.engine.kinds),
+            "buckets": list(self.engine.buckets),
+            "replicas": self.engine.replica_count,
+        }
+        if status == "error":
+            body["error"] = "engine warmup failed"
+        return body
 
     def metrics(self) -> dict:
         return {
             **self.batcher.metrics(),
+            "engine": self.engine.stats(),
             "compile_counts": self.engine.compile_counts,
         }
 

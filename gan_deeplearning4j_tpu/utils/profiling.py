@@ -90,6 +90,41 @@ class PhaseTimer:
         return "\n".join(out)
 
 
+class StageStats:
+    """Busy-time + latency accounting for a fixed set of pipeline stages.
+
+    The serving batcher splits a flush into assemble (host staging +
+    async dispatch), device (wait-until-ready), and complete (scatter to
+    callers); each stage records its per-flush duration here.
+    ``occupancy()`` is busy-seconds / wall-seconds since construction —
+    the direct read on whether the pipeline overlaps (assemble occupancy
+    ≪ 1 while device occupancy ≈ 1 means the host keeps the device fed).
+    Not synchronized: callers serialize ``add`` per stage (the batcher
+    records each stage from the one thread that runs it)."""
+
+    def __init__(self, stages: Sequence[str], max_samples: int = 65536):
+        self._t0 = time.monotonic()
+        self.busy: Dict[str, float] = {s: 0.0 for s in stages}
+        self.samples: Dict[str, deque] = {
+            s: deque(maxlen=max_samples) for s in stages
+        }
+
+    def add(self, stage: str, seconds: float) -> None:
+        self.busy[stage] += seconds
+        self.samples[stage].append(seconds)
+
+    def occupancy(self) -> Dict[str, float]:
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        return {s: b / elapsed for s, b in self.busy.items()}
+
+    def summary_ms(self) -> Dict[str, Dict[str, float]]:
+        return {
+            s: {k: v * 1e3 for k, v in percentiles(samples).items()}
+            for s, samples in self.samples.items()
+            if samples
+        }
+
+
 @contextlib.contextmanager
 def device_trace(log_dir: Optional[str]) -> Iterator[None]:
     """Capture an XLA device trace under ``log_dir`` (viewable in

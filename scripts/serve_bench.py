@@ -6,19 +6,36 @@ classifier), publishes them through the serializer exactly as a training
 run would, loads them back through the serving loader, then drives the
 in-process service with a mixed workload: every worker thread loops
 submit→wait→submit (closed loop) over randomized request kinds and batch
-sizes. Writes a BENCH-style JSON artifact with throughput, latency
-percentiles, batch-occupancy histogram, shed counts, and the distinct-
-compile count — and FAILS (exit 1) if any serving invariant breaks:
+sizes, followed by an OVERLOAD phase (tiny queue, tight deadlines, more
+clients than slots) that proves shedding stays explicit under pressure.
+Writes a BENCH-style JSON artifact with throughput, latency percentiles,
+per-stage pipeline breakdown (assemble/device/complete), batch-occupancy
+histogram, shed counts, and the compile ledger — and FAILS (exit 1) if
+any serving invariant breaks:
 
-- zero lost requests: every submit returns ok or an explicit shed;
-- bounded compiles: per-kind XLA compiles ≤ the bucket-ladder size
-  (mixed request sizes must ride the padded buckets, never re-compile).
+- zero lost requests: every submit returns ok or an explicit shed, in
+  the main phase AND the overload phase;
+- bounded compiles: per-kind XLA compiles ≤ the engine's declared bound
+  (ladder size × replicas, +1 bulk lane when multi-replica);
+- no serve-time compiles: after warmup, the compile count per kind must
+  not move (mixed request sizes ride the padded buckets, never re-compile).
 
-CPU run (the CI shape)::
+CPU runs (the CI shapes)::
 
     JAX_PLATFORMS=cpu python scripts/serve_bench.py \\
         --requests 200 --threads 8 --buckets 1,8,32 \\
         --output artifacts/serve_bench.json
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py --smoke   # campaign gate
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py --replicas 2
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py --legacy  # PR 3 path A/B
+
+``--replicas N`` on a CPU host forces N virtual host devices (the flag
+must land before jax initializes, which is why it is handled at the top
+of ``main``); on a real TPU it routes across the chips that exist.
+``--record TAG`` additionally writes ``BENCH_serving_<TAG>.json`` at the
+repo root so the serving perf trajectory is tracked alongside the
+training bench files.
 """
 
 from __future__ import annotations
@@ -66,6 +83,78 @@ def build_bundle(directory: str, seed: int = 666) -> dict:
     }
 
 
+def _drive(service, kinds, width, sizes, requests, threads, seed,
+           timeout=None):
+    """Closed-loop phase: ``threads`` clients loop submit→wait→submit.
+    Returns (statuses, rows_done, elapsed) — one status per request, the
+    zero-lost ledger."""
+    statuses = []
+    lock = threading.Lock()
+    per_thread = requests // threads
+    rows_done = [0]
+
+    def worker(widx: int) -> None:
+        rng = np.random.default_rng(seed + widx)
+        for _ in range(per_thread):
+            kind = kinds[rng.integers(len(kinds))]
+            n = int(sizes[rng.integers(len(sizes))])
+            rows = rng.random((n, width[kind]), dtype=np.float32)
+            if kind == "sample":
+                rows = rows * 2.0 - 1.0
+            res = service.batcher.submit(kind, rows, timeout=timeout)
+            with lock:
+                statuses.append(res.status)
+                if res.ok:
+                    rows_done[0] += res.data.shape[0]
+
+    workers = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(threads)
+    ]
+    t0 = time.perf_counter()
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    return statuses, rows_done[0], time.perf_counter() - t0
+
+
+def _make_service(engine, args, legacy: bool):
+    from gan_deeplearning4j_tpu.serving import InferenceService, MicroBatcher
+
+    if legacy:
+        # the PR 3 path, same artifacts/knobs: host-side concat+pad
+        # assembly on replica 0 and a strictly serial flush loop
+        class _LegacyService:
+            def __init__(self, engine):
+                self.engine = engine
+                self.batcher = MicroBatcher(
+                    engine.run_host,
+                    max_batch=engine.buckets[-1],
+                    max_latency=args.max_latency,
+                    max_queue=args.max_queue,
+                    default_timeout=args.timeout,
+                    pipeline_depth=1,
+                )
+
+            def metrics(self):
+                return {**self.batcher.metrics(),
+                        "compile_counts": self.engine.compile_counts}
+
+            def close(self):
+                self.batcher.close()
+
+        return _LegacyService(engine)
+    return InferenceService(
+        engine,
+        max_latency=args.max_latency,
+        max_queue=args.max_queue,
+        default_timeout=args.timeout,
+        warmup=False,  # the bench warms (and times) the engine itself
+        pipeline_depth=args.pipeline_depth,
+    )
+
+
 def run_bench(args) -> dict:
     from gan_deeplearning4j_tpu.serving import InferenceService, ServingEngine
 
@@ -76,61 +165,95 @@ def run_bench(args) -> dict:
             classifier=bundle["classifier"],
             buckets=args.buckets,
             feature_vertex=bundle["feature_vertex"],
+            replicas=args.replicas,
         )
         t_compile = time.perf_counter()
         engine.warmup()
         compile_s = time.perf_counter() - t_compile
-        service = InferenceService(
-            engine,
-            max_latency=args.max_latency,
-            max_queue=args.max_queue,
-            default_timeout=args.timeout,
-            warmup=False,
-        )
+        warm_compiles = engine.compile_counts
+        service = _make_service(engine, args, args.legacy)
 
         width = {"sample": bundle["z_size"],
                  "classify": bundle["num_features"],
                  "features": bundle["num_features"]}
         kinds = list(engine.kinds)
         sizes = [s for s in args.sizes if s <= max(args.buckets)]
-        statuses = []  # one entry per request — the zero-lost ledger
-        lock = threading.Lock()
-        per_thread = args.requests // args.threads
-        rows_done = [0]
 
-        def worker(widx: int) -> None:
-            rng = np.random.default_rng(args.seed + widx)
-            for i in range(per_thread):
-                kind = kinds[rng.integers(len(kinds))]
-                n = int(sizes[rng.integers(len(sizes))])
-                rows = rng.random((n, width[kind]), dtype=np.float32)
-                if kind == "sample":
-                    rows = rows * 2.0 - 1.0
-                res = service.batcher.submit(kind, rows)
-                with lock:
-                    statuses.append(res.status)
-                    if res.ok:
-                        rows_done[0] += res.data.shape[0]
-
-        threads = [
-            threading.Thread(target=worker, args=(w,), daemon=True)
-            for w in range(args.threads)
-        ]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        elapsed = time.perf_counter() - t0
+        statuses, rows_ok, elapsed = _drive(
+            service, kinds, width, sizes, args.requests, args.threads,
+            args.seed,
+        )
         metrics = service.metrics()
         service.close()
 
-    submitted = args.threads * per_thread
+        # -- overload phase: more clients than queue slots, tight deadlines;
+        # every submit must still get exactly one explicit result
+        overload = {"requests": 0}
+        if args.overload_requests > 0:
+            ob = InferenceService(
+                engine,
+                max_latency=args.max_latency,
+                max_queue=args.overload_queue,
+                default_timeout=args.overload_timeout,
+                warmup=False,
+                pipeline_depth=args.pipeline_depth,
+            )
+            o_statuses, _, o_elapsed = _drive(
+                ob, kinds, width, sizes, args.overload_requests,
+                args.overload_threads, args.seed + 1000,
+            )
+            ob.close()
+            overload = {
+                "requests": (args.overload_threads
+                             * (args.overload_requests
+                                // args.overload_threads)),
+                "returned": len(o_statuses),
+                "ok": sum(1 for s in o_statuses if s == "ok"),
+                "shed": sum(1 for s in o_statuses
+                            if s in ("overloaded", "deadline")),
+                "errors": sum(1 for s in o_statuses if s == "error"),
+                "elapsed_s": o_elapsed,
+                "max_queue": args.overload_queue,
+                "timeout_s": args.overload_timeout,
+            }
+
+        # -- compare phase: alternate fast-path and legacy (PR 3) rounds in
+        # THIS process against the same warm engine — paired rounds cancel
+        # the machine noise that cross-process A/B runs soak up
+        compare = None
+        if args.compare > 0:
+            rounds = []
+            for _ in range(args.compare):
+                row = {}
+                for label, legacy in (("fast", False), ("legacy", True)):
+                    svc = _make_service(engine, args, legacy)
+                    _, rows_n, secs = _drive(
+                        svc, kinds, width, sizes, args.requests,
+                        args.threads, args.seed,
+                    )
+                    row[f"{label}_flushes"] = svc.metrics()["flushes"]
+                    svc.close()
+                    row[label] = rows_n / secs if secs > 0 else 0.0
+                row["ratio"] = (row["fast"] / row["legacy"]
+                                if row["legacy"] > 0 else 0.0)
+                rounds.append(row)
+            ratios = sorted(r["ratio"] for r in rounds)
+            compare = {
+                "rounds": rounds,
+                "median_ratio": ratios[len(ratios) // 2],
+            }
+
+        serve_compiles = engine.serve_compile_counts
+        compile_counts = engine.compile_counts
+        max_compiles = engine.expected_max_compiles
+        replica_dispatches = engine.stats()["replica_dispatches"]
+
+    submitted = args.threads * (args.requests // args.threads)
     lost = submitted - len(statuses)
     ok = sum(1 for s in statuses if s == "ok")
     shed = sum(1 for s in statuses if s in ("overloaded", "deadline"))
     errors = sum(1 for s in statuses if s == "error")
-    compile_counts = metrics["compile_counts"]
+    o_lost = overload.get("requests", 0) - overload.get("returned", 0)
     summary = {
         "bench": "serve_bench",
         "config": {
@@ -138,6 +261,9 @@ def run_bench(args) -> dict:
             "threads": args.threads,
             "buckets": list(args.buckets),
             "sizes": sizes,
+            "replicas": args.replicas,
+            "pipeline_depth": args.pipeline_depth,
+            "legacy": bool(args.legacy),
             "max_latency_s": args.max_latency,
             "max_queue": args.max_queue,
             "timeout_s": args.timeout,
@@ -150,17 +276,28 @@ def run_bench(args) -> dict:
             "lost": lost,
             "elapsed_s": elapsed,
             "warmup_compile_s": compile_s,
+            "warmup_compile_counts": warm_compiles,
             "throughput_rps": submitted / elapsed if elapsed > 0 else 0.0,
-            "throughput_rows_per_s": rows_done[0] / elapsed if elapsed > 0 else 0.0,
+            "throughput_rows_per_s": rows_ok / elapsed if elapsed > 0 else 0.0,
             "latency_ms": metrics["latency_ms"],
             "batch_occupancy": metrics["batch_occupancy"],
             "flushes": metrics["flushes"],
+            "pipeline": metrics["pipeline"],
             "compile_counts": compile_counts,
+            "serve_compile_counts": serve_compiles,
+            "replica_dispatches": replica_dispatches,
         },
+        "overload": overload,
+        "compare": compare,
         "invariants": {
             "zero_lost": lost == 0 and errors == 0,
+            "overload_zero_lost": (
+                o_lost == 0 and overload.get("errors", 0) == 0),
             "compiles_bounded": all(
-                c <= len(args.buckets) for c in compile_counts.values()
+                c <= max_compiles for c in compile_counts.values()
+            ),
+            "no_serve_time_compiles": all(
+                c == 0 for c in serve_compiles.values()
             ),
         },
     }
@@ -176,19 +313,79 @@ def main(argv=None) -> int:
     p.add_argument("--sizes", default="1,2,5,8,13,32",
                    type=lambda s: [int(b) for b in s.split(",")],
                    help="request batch sizes the generator mixes over")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="devices to route across (CPU: forces this many "
+                        "virtual host devices)")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="in-flight flush window (default: 2 per replica)")
+    p.add_argument("--legacy", action="store_true",
+                   help="measure the PR 3 path: host concat+pad assembly, "
+                        "serial flushes, replica 0 only")
+    p.add_argument("--compare", type=int, default=0, metavar="ROUNDS",
+                   help="after the main phase, alternate ROUNDS of "
+                        "fast-path vs legacy rounds in-process and report "
+                        "the paired speedup (noise-robust A/B)")
     p.add_argument("--max-latency", type=float, default=0.002)
     p.add_argument("--max-queue", type=int, default=256)
     p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--overload-requests", type=int, default=64,
+                   help="overload-phase request count (0 disables the phase)")
+    p.add_argument("--overload-threads", type=int, default=16)
+    p.add_argument("--overload-queue", type=int, default=4)
+    p.add_argument("--overload-timeout", type=float, default=0.5)
+    p.add_argument("--smoke", action="store_true",
+                   help="small fixed shape for CI/campaign gating")
     p.add_argument("--seed", type=int, default=666)
+    p.add_argument("--record", default=None, metavar="TAG",
+                   help="also write BENCH_serving_<TAG>.json at the repo root")
+    p.add_argument("--compilation-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile cache dir (restarts reuse "
+                        "AOT artifacts)")
     p.add_argument("--output", default=os.path.join(_REPO, "artifacts", "serve_bench.json"))
     args = p.parse_args(argv)
+
+    if args.smoke:
+        args.requests = min(args.requests, 48)
+        args.threads = min(args.threads, 4)
+        args.buckets = (1, 8)
+        args.sizes = [1, 3, 8]
+        args.overload_requests = min(args.overload_requests, 32)
+        args.overload_threads = min(args.overload_threads, 8)
+
+    # forcing virtual host devices must happen before jax initializes. The
+    # flag only affects the HOST (CPU) platform — on a real TPU the bench
+    # routes across the chips that exist and this is inert — so it is safe
+    # to set unconditionally (covers CPU-only hosts with JAX_PLATFORMS
+    # unset, where jax silently falls back to 1 CPU device).
+    if args.replicas > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.replicas}"
+            ).strip()
+
+    if args.compilation_cache:
+        from gan_deeplearning4j_tpu.runtime.environment import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache(args.compilation_cache)
 
     summary = run_bench(args)
     os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
     with open(args.output, "w") as fh:
         json.dump(summary, fh, indent=2)
         fh.write("\n")
+    if args.record:
+        with open(os.path.join(_REPO, f"BENCH_serving_{args.record}.json"),
+                  "w") as fh:
+            json.dump(summary, fh, indent=2)
+            fh.write("\n")
     sys.stdout.write(json.dumps(summary["results"], indent=2) + "\n")
+    if summary.get("compare"):
+        sys.stdout.write(json.dumps({"compare": summary["compare"]}, indent=2)
+                         + "\n")
     bad = [k for k, v in summary["invariants"].items() if not v]
     if bad:
         sys.stderr.write(f"serve_bench: invariants violated: {bad}\n")

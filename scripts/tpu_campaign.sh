@@ -26,6 +26,16 @@ if ! bash scripts/lint_gate.sh --full > lint_gate.log 2>&1; then
   echo "$(date +%H:%M:%S) jaxlint gate failed — campaign aborted (see lint_gate.log)" >> tpu_poller.log
   exit 1
 fi
+# Serving smoke (CPU, small fixed shape): the campaign ships artifacts a
+# serving replica must be able to load and serve — refuse to start if the
+# serve path regressed (zero-lost / bounded-compile / no-serve-time-compile
+# invariants, enforced by serve_bench's own exit code). Pinned to CPU so it
+# never touches the chip the campaign is about to hold.
+if ! JAX_PLATFORMS=cpu timeout 600 python scripts/serve_bench.py --smoke \
+    --output artifacts/serve_bench_smoke.json > serve_bench_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) serve_bench smoke failed — campaign aborted (see serve_bench_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

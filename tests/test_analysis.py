@@ -1069,6 +1069,162 @@ class TestDeadDonatedOutSharding:
 
 
 # ===========================================================================
+# JG013 — sharding spec names axes the mesh does not have
+# ===========================================================================
+
+class TestMeshAxisMismatch:
+    def test_true_positive_named_sharding_unknown_axis(self):
+        # the spec was written for a ("data",) trainer mesh but paired with
+        # the 1-D ("replica",) serving mesh — jax rejects it only at use time
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build():\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('replica',))\n"
+            "    return NamedSharding(mesh, PartitionSpec('data'))\n"
+        )
+        assert codes(r) == ["JG013"]
+        assert "'data'" in r.active[0].message
+        assert "replica" in r.active[0].message
+
+    def test_true_positive_shard_map_in_specs(self):
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, PartitionSpec as P\n"
+            "def runner(f, xs):\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('data',))\n"
+            "    return jax.shard_map(f, mesh=mesh, in_specs=(P('model'),),\n"
+            "                         out_specs=P('data'))(xs)\n"
+        )
+        assert codes(r) == ["JG013"]
+        assert "in_specs" in r.active[0].message
+
+    def test_true_positive_shard_map_positional_specs(self):
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, PartitionSpec as P\n"
+            "def runner(f, xs):\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('replica',))\n"
+            "    return jax.shard_map(f, mesh, P('model'), P('replica'))(xs)\n"
+        )
+        assert codes(r) == ["JG013"]
+        assert "in_specs" in r.active[0].message
+
+    def test_true_positive_axis_used_twice_in_one_spec(self):
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build():\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('data',))\n"
+            "    return NamedSharding(mesh, PartitionSpec('data', 'data'))\n"
+        )
+        assert codes(r) == ["JG013"]
+        assert "two dimensions" in r.active[0].message
+
+    def test_true_negative_matching_axes(self):
+        # the serving engine's bulk-lane shape: 1-D replica mesh, replicated
+        # params + batch sharded on the replica axis
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build():\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('replica',))\n"
+            "    rep = NamedSharding(mesh, PartitionSpec())\n"
+            "    return rep, NamedSharding(mesh, PartitionSpec('replica'))\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_none_entries_and_tuple_axes(self):
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build():\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('a', 'b'))\n"
+            "    return NamedSharding(mesh, PartitionSpec(None, ('a', 'b')))\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_unresolvable_mesh_is_silence(self):
+        # mesh comes in as a parameter — axes unknowable, no guess
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def build(mesh):\n"
+            "    return NamedSharding(mesh, PartitionSpec('data'))\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_reassigned_mesh_is_silence(self):
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build(flag):\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('a',))\n"
+            "    if flag:\n"
+            "        mesh = Mesh(np.asarray(jax.devices()), ('b',))\n"
+            "    return NamedSharding(mesh, PartitionSpec('a'))\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_rebound_to_helper_is_silence(self):
+        # first binding is a literal mesh, but the name is REBOUND to a
+        # helper whose axes are unknowable — certainty is gone, so silence
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build(make_2d):\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('replica',))\n"
+            "    mesh = make_2d()\n"
+            "    return NamedSharding(mesh, PartitionSpec('model'))\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_parameter_default_mesh_is_silence(self):
+        # the mesh may arrive from the caller — the body binding is only a
+        # fallback, so axes are not statically certain
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build(mesh=None):\n"
+            "    if mesh is None:\n"
+            "        mesh = Mesh(np.asarray(jax.devices()), ('a',))\n"
+            "    return NamedSharding(mesh, PartitionSpec('b'))\n"
+        )
+        assert codes(r) == []
+
+    def test_make_mesh_axis_names_kwarg(self):
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def build():\n"
+            "    mesh = jax.make_mesh((4,), axis_names=('x',))\n"
+            "    return NamedSharding(mesh, PartitionSpec('y'))\n"
+        )
+        assert codes(r) == ["JG013"]
+
+    def test_suppression_applies(self):
+        r = run(
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, NamedSharding, PartitionSpec\n"
+            "def build():\n"
+            "    mesh = Mesh(np.asarray(jax.devices()), ('replica',))\n"
+            "    return NamedSharding(mesh, PartitionSpec('data'))  # jaxlint: disable=JG013\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG013"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 
