@@ -25,6 +25,7 @@ from gan_deeplearning4j_tpu.analysis.rules.donation_flow import DonationFlow
 from gan_deeplearning4j_tpu.analysis.rules.axes import AxisSizeMismatch
 from gan_deeplearning4j_tpu.analysis.rules.sharding import DeadDonatedOutSharding
 from gan_deeplearning4j_tpu.analysis.rules.mesh_axes import MeshAxisMismatch
+from gan_deeplearning4j_tpu.analysis.rules.prng_flow import CrossModulePrngReuse
 
 RULES = [
     PrngKeyReuse(),
@@ -40,6 +41,7 @@ RULES = [
     AxisSizeMismatch(),
     DeadDonatedOutSharding(),
     MeshAxisMismatch(),
+    CrossModulePrngReuse(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

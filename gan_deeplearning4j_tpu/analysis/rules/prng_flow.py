@@ -1,0 +1,195 @@
+"""JG014 — cross-module PRNG key reuse.
+
+JG001 sees a key consumed twice by *jax.random* calls in one scope. It is
+blind to the indirection this repo actually uses: a key handed to a helper
+function (``sample_z(key, n)``) that consumes it internally. Passing the
+same key to two such helpers — or to a helper AND a direct ``jax.random``
+draw — correlates their streams exactly like the scope-local bug, and no
+scope-local rule can see it because the consumption happens a module away.
+
+This rule consumes the project index's ``prng_params`` summaries (recorded
+since PR 2, unconsumed until now — the ROADMAP item). A *hand-off* is a
+call whose callee resolves to an indexed project function and whose
+argument lands on a parameter the summary marks PRNG-like; it only counts
+when the callee (transitively, over resolved project calls) actually
+consumes entropy — a derive-only helper (``wkey = lambda k: fold_in(k, i)``
+style) is not a consumer, so handing the same base key to it twice with
+different salts stays silent.
+
+Findings fire on the same-scope straight-line pattern (two uses of one key
+expression with no rebinding between) and on the loop-replay pattern (a
+consuming hand-off inside a loop whose key derives from nothing bound per
+iteration). Pairs where BOTH uses are direct ``jax.random`` calls are
+JG001's findings, not ours — one defect, one code.
+
+Known false-negative classes (deliberate, silent side): keys smuggled
+through containers or object attributes; callees resolvable only through
+``self.``-dispatch; entropy consumption behind an unresolvable call.
+
+``skip_tests``: test modules reuse keys *deliberately* (same-key parity
+and determinism assertions are the point of half of ``tests/test_rng.py``),
+so the cross-module rule exempts them like JG003 does.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from gan_deeplearning4j_tpu.analysis import _common
+from gan_deeplearning4j_tpu.analysis.rules.prng import (
+    _consumer_name,
+    _expr_base,
+    _key_arg,
+    _stmt_eval_roots,
+)
+
+
+class CrossModulePrngReuse:
+    code = "JG014"
+    name = "prng-key-reuse-cross-module"
+    summary = ("same PRNG key handed to two entropy-consuming calls "
+               "(project key-taking functions included) without an "
+               "intervening split/fold_in")
+    skip_tests = True
+
+    def check(self, mod):
+        index = getattr(mod, "project", None)
+        if index is None:  # single-module entry without phase 1 — no facts
+            return
+        self._consumes_cache: Dict[str, bool] = {}
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if not body:
+                continue
+            yield from self._scan_block(body, {}, mod, index)
+
+    # -- entropy consumption (transitive, over the index) -----------------
+    def _consumes_entropy(self, summary, index, seen=frozenset()) -> bool:
+        """Does ``summary`` draw from jax.random (directly or through
+        resolved project calls)? Unresolvable callees count as 'no' — the
+        silent side; a derive-only helper must not turn its callers'
+        salted hand-offs into findings."""
+        if summary.fq in self._consumes_cache:
+            return self._consumes_cache[summary.fq]
+        if summary.fq in seen:
+            return False
+        owner = index.modules.get(summary.module)
+        if owner is None or summary.node is None:
+            return False
+        for node in ast.walk(summary.node):
+            if isinstance(node, ast.Call) and _consumer_name(
+                    node, owner.srcmod) is not None:
+                self._consumes_cache[summary.fq] = True
+                return True
+        seen = seen | {summary.fq}
+        for callee in summary.calls:
+            target = index.lookup(callee)
+            if target is not None and self._consumes_entropy(
+                    target, index, seen):
+                self._consumes_cache[summary.fq] = True
+                return True
+        self._consumes_cache[summary.fq] = False
+        return False
+
+    # -- per-statement uses ----------------------------------------------
+    def _uses_in(self, roots, mod, index):
+        """(call, key_expr_node, description, is_handoff) for every
+        entropy use under ``roots``: direct jax.random consumers plus
+        hand-offs into consuming project functions."""
+        out = []
+        for node in _common.walk_excluding_defs(roots):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _consumer_name(node, mod)
+            if fn is not None:
+                key = _key_arg(node)
+                if key is not None:
+                    out.append((node, key, f"jax.random.{fn}", False))
+                continue
+            summary = index.resolve_function(mod, node.func)
+            if summary is None or not summary.prng_params:
+                continue
+            if not self._consumes_entropy(summary, index):
+                continue
+            for i, arg in enumerate(node.args):
+                if (i < len(summary.params)
+                        and summary.params[i] in summary.prng_params):
+                    out.append((node, arg, summary.fq, True))
+            for kw in node.keywords:
+                if kw.arg in summary.prng_params and kw.value is not None:
+                    out.append((node, kw.value, summary.fq, True))
+        out.sort(key=lambda t: (t[0].lineno, t[0].col_offset))
+        return out
+
+    def _stmt_uses(self, stmt, mod, index):
+        return self._uses_in(_stmt_eval_roots(stmt), mod, index)
+
+    # -- block scan (JG001's shape, mixed-use tracking) -------------------
+    def _scan_block(self, stmts, used, mod, index):
+        """``used``: key expression text -> (line, description,
+        is_handoff). A second use fires only when at least one side is a
+        hand-off — direct/direct pairs are JG001's findings."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes handled by iter_scopes
+            for call, key, desc, is_handoff in self._stmt_uses(
+                    stmt, mod, index):
+                expr = ast.unparse(key)
+                if expr in used:
+                    first_line, first_desc, first_handoff = used[expr]
+                    if is_handoff or first_handoff:
+                        f = mod.finding(
+                            self.code,
+                            f"PRNG key `{expr}` already consumed by "
+                            f"{first_desc} at line {first_line} — this "
+                            f"call consumes the same stream "
+                            f"({desc} takes it as a PRNG key); "
+                            f"split/fold_in between the two",
+                            call,
+                        )
+                        yield f, call
+                else:
+                    used[expr] = (call.lineno, desc, is_handoff)
+            rebound = _common.assignment_targets(stmt)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                _common._target_names(stmt.target, rebound)
+            if rebound:
+                for expr in [e for e in used if _expr_base(e) in rebound]:
+                    del used[expr]
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                yield from self._scan_loop(stmt, dict(used), mod, index)
+            elif isinstance(stmt, ast.If):
+                yield from self._scan_block(stmt.body, dict(used), mod, index)
+                yield from self._scan_block(stmt.orelse, dict(used), mod,
+                                            index)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._scan_block(stmt.body, used, mod, index)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._scan_block(block, used, mod, index)
+                for handler in stmt.handlers:
+                    yield from self._scan_block(handler.body, dict(used),
+                                                mod, index)
+
+    def _scan_loop(self, loop, used, mod, index):
+        """Hand-off loop replay: a consuming hand-off whose key derives
+        from nothing the loop binds replays one stream every iteration
+        (JG001 owns the direct-consumer version of this check)."""
+        yield from self._scan_block(loop.body, used, mod, index)
+        loop_bound = _common.bound_names(loop)
+        for call, key, desc, is_handoff in self._uses_in(
+                loop.body, mod, index):
+            if not is_handoff:
+                continue
+            if not (_common.loaded_names(key) & loop_bound):
+                expr = ast.unparse(key)
+                f = mod.finding(
+                    self.code,
+                    f"PRNG key `{expr}` handed to {desc} inside a loop "
+                    f"but derived outside it — every iteration replays "
+                    f"the same stream; fold_in the loop index",
+                    call,
+                )
+                yield f, call

@@ -794,10 +794,14 @@ class GanExperiment:
         write_csv(path, preds, precision=6)
         return path
 
-    def save_models(self) -> List[str]:
-        """All four models with updater state, every iteration (I16)."""
+    def save_models(self, directory: Optional[str] = None) -> List[str]:
+        """All four models with updater state, every iteration (I16).
+        ``directory`` overrides ``config.output_dir`` — the resume entry
+        point the resilience store's publish callback writes through (a
+        generation stages into its own directory, never the live one)."""
         cfg = self.config
-        os.makedirs(cfg.output_dir, exist_ok=True)
+        directory = directory or cfg.output_dir
+        os.makedirs(directory, exist_ok=True)
         out = []
         models = [
             ("dis", self.dis, self.dis_state),
@@ -807,12 +811,13 @@ class GanExperiment:
         if self.cv is not None:
             models.append(("CV", self.cv, self.cv_state))
         for name, graph, state in models:
-            path = os.path.join(cfg.output_dir, f"{cfg.file_prefix}_{name}_model.zip")
+            path = os.path.join(directory, f"{cfg.file_prefix}_{name}_model.zip")
             write_model(path, graph, state, save_updater=True)
             out.append(path)
         return out
 
-    def publish_for_serving(self, directory: Optional[str] = None) -> Dict:
+    def publish_for_serving(self, directory: Optional[str] = None,
+                            store=None) -> Dict:
         """Publish the trained INFERENCE artifacts — the paper's end product:
         the generator used only for sampling plus the discriminator-feature
         classifier (SURVEY §0) — as a serving bundle the ``serving/``
@@ -824,13 +829,47 @@ class GanExperiment:
         the checkpoints, the feature vertex for the features endpoint, and
         the request shapes. Every file lands via write-to-temp + atomic
         rename (``write_model`` and the manifest both), so a reload loop
-        polling the directory can never observe a truncated artifact."""
+        polling the directory can never observe a truncated artifact.
+
+        ``store`` (a ``resilience.CheckpointStore``) publishes the bundle
+        as a digest-verified store *generation* instead of a bare
+        directory: the manifest's ``generation`` field is then the version
+        a bundle-reload loop keys on (None for unversioned directory
+        publishes — no serving behavior change either way)."""
+        if store is not None:
+            # single-writer store: the number reserved here is the number
+            # publish() assigns, and the check below makes any future
+            # concurrent-writer regression loud instead of silently
+            # mislabeling the bundle
+            number = store.next_number()
+            result: Dict = {}
+            generation = store.publish(
+                lambda d: result.update(
+                    self._write_serving_bundle(d, generation=number)
+                ),
+                step=int(self.gan_state.step),
+                extra={"kind": "serving"},
+            )
+            if generation.number != number:
+                raise RuntimeError(
+                    f"serving bundle labeled generation {number} but the "
+                    f"store assigned {generation.number} — concurrent writer?"
+                )
+            return {**result, "directory": generation.path}
+        cfg = self.config
+        directory = directory or os.path.join(cfg.output_dir, "serving")
+        os.makedirs(directory, exist_ok=True)
+        manifest = self._write_serving_bundle(directory, generation=None)
+        return {**manifest, "directory": directory}
+
+    def _write_serving_bundle(self, directory: str,
+                              generation: Optional[int]) -> Dict:
+        """Write the gen(+CV) serving checkpoints and ``serving.json`` into
+        ``directory``; returns the manifest."""
         import json as _json
         import tempfile as _tempfile
 
         cfg = self.config
-        directory = directory or os.path.join(cfg.output_dir, "serving")
-        os.makedirs(directory, exist_ok=True)
         gen_name = f"{cfg.file_prefix}_gen_serving.zip"
         write_model(
             os.path.join(directory, gen_name), self.gen, self.gen_params,
@@ -857,6 +896,7 @@ class GanExperiment:
             "num_features": int(cfg.num_features),
             "num_classes": int(cfg.num_classes),
             "step": int(self.gan_state.step),
+            "generation": generation,
         }
         fd, tmp = _tempfile.mkstemp(dir=directory, suffix=".tmp")
         try:
@@ -868,7 +908,7 @@ class GanExperiment:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
-        return {**manifest, "directory": directory}
+        return manifest
 
     def load_models(self, directory: Optional[str] = None) -> int:
         """Resume: restore every state ``save_models`` wrote (params + updater
@@ -927,6 +967,9 @@ class GanExperiment:
             not getattr(self, "_supports_device_loop", False)  # phased path
             or (cfg.save_models and cfg.checkpoint_every <= 1)
             or cfg.loss_fetch_every <= 1
+            # an epilogue hook observes state after EVERY iteration, so
+            # every iteration must be a window boundary
+            or getattr(self, "_epilogue_active", False)
         ):
             return 1
         i = self.batch_counter
@@ -941,7 +984,8 @@ class GanExperiment:
             w = min(w, 1 if r == 0 else every - r + 1)
         return max(1, w)
 
-    def run(self, train_iterator, test_iterator=None, eval_callback=None) -> Dict:
+    def run(self, train_iterator, test_iterator=None, eval_callback=None,
+            epilogue_callback=None) -> Dict:
         """The training loop — host feeds WINDOWS, the device runs them.
 
         ``eval_callback(experiment, index)``, when given, fires at every
@@ -950,6 +994,14 @@ class GanExperiment:
         in-training evaluation such as FID-based best-checkpoint selection
         (``scripts/quality_run.py``). It runs on the host between windows, so
         its cost gates training only at boundaries, never inside a window.
+
+        ``epilogue_callback(experiment, index)``, when given, fires after
+        EVERY iteration's epilogue (exports + checkpoint), with windows
+        pinned to 1 so the model state is always current at the call; a
+        ``False`` return stops the loop cleanly after the current
+        iteration — the preemption/supervision entry point (a resilience
+        supervisor publishes a store generation here, or drains out on a
+        preemption flag without losing the iteration that just finished).
 
         Up to ``config.loss_fetch_every`` iterations at a time execute as one
         ``lax.scan`` dispatch (``train_iterations``); loss scalars come back
@@ -962,6 +1014,7 @@ class GanExperiment:
         history) is identical to the sequential loop; images_per_sec is the
         window average — the honest number under async dispatch."""
         cfg = self.config
+        self._epilogue_active = epilogue_callback is not None
         if cfg.prefetch > 0 and not hasattr(train_iterator, "next_window"):
             # device-resident iterators are already in HBM and expose the
             # one-slice window fast path — wrapping them would hide
@@ -1115,8 +1168,20 @@ class GanExperiment:
                             self.save_models()
                     logger.info("Completed Batch %d!", self.batch_counter)
                     self.batch_counter += 1
+                    # the hook runs with the counter already advanced, so
+                    # batch_counter == index == the step count of the state
+                    # it observes — a publishing hook labels its checkpoint
+                    # with the right step
+                    stop = (
+                        epilogue_callback is not None
+                        and epilogue_callback(self, index) is False
+                    )
+                    if stop:
+                        break
                 if pending_iters >= max(1, cfg.loss_fetch_every):
                     flush()
+                if stop:
+                    break  # epilogue hook asked for a clean early exit
                 if not carry and not train_iterator.has_next():
                     train_iterator.reset()  # (:600-602)
         flush()

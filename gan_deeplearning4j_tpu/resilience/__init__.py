@@ -1,0 +1,59 @@
+"""resilience/ — fault-tolerant training for preemptible workers.
+
+The ROADMAP's production north star assumes TPU workers that can vanish at
+any step: preemption is a scheduling policy, not an accident. This package
+makes a training run survivable:
+
+- :mod:`.store` — a generation-ledgered checkpoint store: each generation
+  is published temp+fsync+atomic-rename with a manifest of per-file
+  content digests; reads re-verify the digests, quarantine corrupt
+  generations (never serving them as "latest"), and retention GC keeps
+  the newest K plus every N-th generation;
+- :mod:`.supervisor` — runs ``GanExperiment`` in resumable segments:
+  restores params + updater state + step counter from the newest valid
+  generation, traps worker faults with bounded exponential backoff,
+  honors SIGTERM preemption by checkpointing then exiting cleanly, and
+  guarantees *bit-exact* resume (interrupted-and-resumed == uninterrupted
+  at equal total steps);
+- :mod:`.faults` — a deterministic, seeded fault-injection plane (raise /
+  preempt / kill at step N, slow or failed checkpoint writes, byte
+  corruption) that the drill and the tests drive;
+- ``python -m gan_deeplearning4j_tpu.resilience`` — the supervised worker
+  CLI ``scripts/resilience_drill.py`` launches, kills, and relaunches.
+
+Architecture notes: docs/RESILIENCE.md.
+"""
+
+from gan_deeplearning4j_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSchedule,
+    FaultSpec,
+    InjectedFault,
+    corrupt_generation,
+)
+from gan_deeplearning4j_tpu.resilience.store import (
+    CheckpointStore,
+    Generation,
+    tree_digest,
+)
+from gan_deeplearning4j_tpu.resilience.supervisor import (
+    RetryBudgetExceeded,
+    SupervisorConfig,
+    TrainingSupervisor,
+    UnsupportedExperimentError,
+)
+
+__all__ = [
+    "CheckpointStore",
+    "Generation",
+    "tree_digest",
+    "FaultInjector",
+    "FaultSchedule",
+    "FaultSpec",
+    "InjectedFault",
+    "corrupt_generation",
+    "RetryBudgetExceeded",
+    "SupervisorConfig",
+    "TrainingSupervisor",
+    "UnsupportedExperimentError",
+]

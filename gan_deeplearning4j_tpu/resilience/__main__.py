@@ -1,0 +1,102 @@
+"""Supervised training worker CLI —
+``python -m gan_deeplearning4j_tpu.resilience``.
+
+One invocation = one supervisor lifetime. The process-level contract the
+drill (and any orchestrator) relies on:
+
+- exit 0   — run completed (``total_steps`` reached, final generation
+             published);
+- exit 75  — preempted (EX_TEMPFAIL: a checkpoint was published and the
+             worker exited cleanly; relaunch to continue);
+- exit 70  — terminal (EX_SOFTWARE: retry budget exhausted — relaunching
+             without intervention would fail the same way);
+- killed by signal — a hard fault; the store still holds a consistent
+             generation, so relaunching resumes from it.
+
+The run summary (status, steps, restore/publish timings, fault events) is
+written as JSON to ``--summary`` and echoed to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m gan_deeplearning4j_tpu.resilience",
+        description="fault-tolerant supervised training worker",
+    )
+    p.add_argument("--config", required=True,
+                   help="ExperimentConfig JSON file")
+    p.add_argument("--store", required=True, help="checkpoint store root")
+    p.add_argument("--data", required=True,
+                   help="npz with 'features' and 'labels' arrays")
+    p.add_argument("--total-steps", type=int, required=True)
+    p.add_argument("--publish-every", type=int, default=10)
+    p.add_argument("--max-retries", type=int, default=3)
+    p.add_argument("--backoff-base", type=float, default=0.5)
+    p.add_argument("--backoff-max", type=float, default=30.0)
+    p.add_argument("--keep-last", type=int, default=3)
+    p.add_argument("--keep-every", type=int, default=0)
+    p.add_argument("--fault-schedule", default=None,
+                   help="FaultSchedule JSON file (docs/RESILIENCE.md)")
+    p.add_argument("--summary", default=None,
+                   help="write the run summary JSON here as well as stdout")
+    args = p.parse_args(argv)
+
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig
+    from gan_deeplearning4j_tpu.resilience import (
+        FaultInjector,
+        FaultSchedule,
+        RetryBudgetExceeded,
+        SupervisorConfig,
+        TrainingSupervisor,
+    )
+
+    cfg = ExperimentConfig.from_json(args.config)
+    with np.load(args.data) as npz:
+        features, labels = npz["features"], npz["labels"]
+    faults = None
+    if args.fault_schedule:
+        faults = FaultInjector(FaultSchedule.from_json(args.fault_schedule))
+    sup = TrainingSupervisor(
+        cfg,
+        SupervisorConfig(
+            total_steps=args.total_steps,
+            publish_every=args.publish_every,
+            max_retries=args.max_retries,
+            backoff_base_s=args.backoff_base,
+            backoff_max_s=args.backoff_max,
+            keep_last=args.keep_last,
+            keep_every=args.keep_every,
+        ),
+        features, labels,
+        store_root=args.store,
+        faults=faults,
+    )
+    sup.install_signal_handlers()
+
+    def emit(summary: dict) -> None:
+        text = json.dumps(summary, indent=2, default=str)
+        if args.summary:
+            with open(args.summary, "w") as fh:
+                fh.write(text + "\n")
+        print(text)
+
+    try:
+        summary = sup.run()
+    except RetryBudgetExceeded as exc:
+        emit({"status": "terminal", "error": str(exc),
+              "events": sup.events})
+        return 70  # EX_SOFTWARE
+    emit(summary)
+    return 0 if summary["status"] == "completed" else 75  # EX_TEMPFAIL
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,367 @@
+"""Generation-ledgered checkpoint store — the durability layer under the
+fault-tolerant supervisor.
+
+A *generation* is one immutable, self-verifying checkpoint directory:
+
+```
+<root>/
+  ledger.json                      # the generation ledger (atomic updates)
+  generations/
+    gen-00000007/
+      MANIFEST.json                # per-file content digests + step + extras
+      mnist_dis_model.zip          # whatever the writer callback produced
+      ...
+  quarantine/
+    gen-00000006/                  # failed verification — kept for forensics,
+                                   # never selected as "latest"
+  .stage-...                       # transient staging dirs (crash leftovers
+                                   # are swept at store construction)
+```
+
+Publish protocol (crash-safe at every point):
+
+1. the writer callback populates a fresh ``.stage-*`` directory;
+2. ``MANIFEST.json`` (sha256 digest + byte count per file, the step counter,
+   caller extras) is written temp+fsync+rename *inside* the staging dir;
+3. every file and the staging dir itself are fsynced;
+4. ``os.replace`` renames the staging dir to ``generations/gen-N`` — the
+   atomic publication point: a reader either sees the complete generation
+   or nothing;
+5. the ledger records the entry and retention GC runs.
+
+A crash before (4) leaves only a staging dir (swept later); a crash after
+(4) but before (5) leaves a published-but-unledgered generation — the read
+side scans the ``generations/`` directory, not the ledger, precisely so
+that window loses nothing. The ledger is the *bookkeeping* record: status
+transitions (``published`` → ``quarantined`` / ``gc``) and the reasons for
+them, which is what the drill asserts its invariants against.
+
+Read side: ``latest_valid()`` walks published generations newest-first,
+re-hashing every file against its manifest; a corrupt or truncated
+generation is moved to ``quarantine/`` and *flagged in the ledger*, and the
+walk falls back to the previous generation — a half-written or bit-flipped
+checkpoint is never served as "latest".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.utils.serializer import _flatten
+
+MANIFEST_NAME = "MANIFEST.json"
+LEDGER_NAME = "ledger.json"
+FORMAT_VERSION = 1
+
+_GEN_RE = re.compile(r"^gen-(\d{8})$")
+
+
+def gen_dirname(number: int) -> str:
+    return f"gen-{number:08d}"
+
+
+def tree_digest(tree) -> str:
+    """Canonical content digest of a pytree of arrays: sha256 over the
+    sorted ``path|dtype|shape|raw bytes`` stream. Unlike a digest of the
+    checkpoint *zip* (whose deflate stream embeds member timestamps), this
+    is reproducible across runs and processes — the currency of the drill's
+    bit-exact-resume invariant."""
+    import jax
+
+    flat: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        _flatten("t", tree, flat)
+    else:  # TrainState-like: digest params + updater + step
+        _flatten("t/params", tree.params, flat)
+        _flatten("t/updater", tree.opt_state, flat)
+        flat["t/step"] = tree.step
+    flat = jax.device_get(flat)
+    h = hashlib.sha256()
+    for key in sorted(flat):
+        a = np.asarray(flat[key])
+        h.update(key.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return "sha256:" + h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _hash_file(path: str, fsync: bool = False) -> Tuple[str, int]:
+    """(digest, byte count) of a file, streamed in 1 MiB chunks — constant
+    memory on checkpoints of any size. ``fsync=True`` additionally fsyncs
+    the same descriptor (one open per file on the publish path)."""
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+            n += len(chunk)
+        if fsync:
+            os.fsync(fh.fileno())
+    return "sha256:" + h.hexdigest(), n
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """temp + fsync + rename — the only way any metadata file here lands."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+@dataclasses.dataclass
+class Generation:
+    """One verified, readable generation."""
+
+    number: int
+    path: str
+    manifest: dict
+
+    @property
+    def step(self) -> int:
+        return int(self.manifest.get("step", 0))
+
+    def file(self, name: str) -> str:
+        return os.path.join(self.path, name)
+
+
+class CheckpointStore:
+    """The generation-ledgered store. ``keep_last`` newest published
+    generations survive GC unconditionally; additionally every
+    ``keep_every``-th generation number is kept forever (0 = off) — the
+    keep-last-K + keep-every-N retention policy. A ``fault_injector``
+    (``faults.FaultInjector``) hooks the write path for the drill's
+    slow/failed-write scenarios; production passes None."""
+
+    def __init__(self, root: str, keep_last: int = 3, keep_every: int = 0,
+                 fault_injector=None) -> None:
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1 (the store must always "
+                             "retain a newest generation)")
+        if keep_every < 0:
+            raise ValueError("keep_every must be >= 0 (0 = off)")
+        self.root = os.path.abspath(root)
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self.faults = fault_injector
+        self.generations_dir = os.path.join(self.root, "generations")
+        self.quarantine_dir = os.path.join(self.root, "quarantine")
+        os.makedirs(self.generations_dir, exist_ok=True)
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+        # sweep crash leftovers: an unrenamed staging dir was never published
+        for name in os.listdir(self.root):
+            if name.startswith(".stage-"):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    # -- ledger ---------------------------------------------------------
+    @property
+    def ledger_path(self) -> str:
+        return os.path.join(self.root, LEDGER_NAME)
+
+    def ledger(self) -> dict:
+        try:
+            with open(self.ledger_path) as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            # a torn ledger is recoverable: the generations/ dir scan is the
+            # source of truth for what exists; the ledger restarts empty
+            return {"format_version": FORMAT_VERSION, "entries": {}}
+
+    def _update_ledger(self, number: int, **fields) -> None:
+        ledger = self.ledger()
+        entry = ledger["entries"].setdefault(str(number), {})
+        entry.update(fields)
+        _atomic_write_json(self.ledger_path, ledger)
+
+    def entry(self, number: int) -> dict:
+        return self.ledger()["entries"].get(str(number), {})
+
+    # -- enumeration ----------------------------------------------------
+    def _scan(self, directory: str) -> List[int]:
+        out = []
+        for name in os.listdir(directory):
+            m = _GEN_RE.match(name)
+            if m and os.path.isdir(os.path.join(directory, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def published(self) -> List[int]:
+        """Generation numbers currently live under ``generations/``
+        (ascending). The directory scan — not the ledger — defines
+        liveness, so a publish that crashed before its ledger write still
+        counts."""
+        return self._scan(self.generations_dir)
+
+    def quarantined(self) -> List[int]:
+        return self._scan(self.quarantine_dir)
+
+    def next_number(self) -> int:
+        """Monotonic across GC and quarantine: one more than anything the
+        directories or the ledger have ever seen."""
+        seen = self.published() + self.quarantined()
+        ledger_nums = [int(k) for k in self.ledger()["entries"]]
+        return max(seen + ledger_nums, default=-1) + 1
+
+    # -- publish --------------------------------------------------------
+    def publish(self, writer: Callable[[str], None], step: int,
+                extra: Optional[dict] = None) -> Generation:
+        """Publish one generation. ``writer(staging_dir)`` populates the
+        directory; everything it wrote is digested into the manifest and
+        becomes immutable once the atomic rename lands."""
+        number = self.next_number()
+        staging = os.path.join(
+            self.root, f".stage-{gen_dirname(number)}-{os.getpid()}"
+        )
+        os.makedirs(staging)
+        try:
+            if self.faults is not None:
+                self.faults.on_checkpoint_write(step)
+            writer(staging)
+            files: Dict[str, dict] = {}
+            for name in sorted(os.listdir(staging)):
+                # one streamed pass per file: digest AND fsync on the same
+                # descriptor — constant memory however large the checkpoint
+                digest, size = _hash_file(os.path.join(staging, name),
+                                          fsync=True)
+                files[name] = {"digest": digest, "bytes": size}
+            if not files:
+                raise ValueError("publish writer produced no files — an "
+                                 "empty generation can never be restored")
+            manifest = {
+                "format_version": FORMAT_VERSION,
+                "generation": number,
+                "step": int(step),
+                "files": files,
+                **(extra or {}),
+            }
+            # the manifest itself is fsynced inside _atomic_write_json
+            _atomic_write_json(os.path.join(staging, MANIFEST_NAME), manifest)
+            _fsync_dir(staging)
+            final = os.path.join(self.generations_dir, gen_dirname(number))
+            os.replace(staging, final)  # THE publication point
+            _fsync_dir(self.generations_dir)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        self._update_ledger(number, status="published", step=int(step),
+                            published_at=time.time())
+        self.gc()
+        return Generation(number=number, path=final, manifest=manifest)
+
+    # -- read side ------------------------------------------------------
+    def verify(self, number: int) -> Optional[str]:
+        """None when generation ``number`` is intact; otherwise the reason
+        it is not (unparseable/missing manifest, missing member, size or
+        digest mismatch)."""
+        path = os.path.join(self.generations_dir, gen_dirname(number))
+        mpath = os.path.join(path, MANIFEST_NAME)
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError) as exc:
+            return f"manifest unreadable: {exc}"
+        if manifest.get("format_version", 0) > FORMAT_VERSION:
+            return (f"manifest format {manifest['format_version']} is newer "
+                    f"than supported {FORMAT_VERSION}")
+        for name, meta in manifest.get("files", {}).items():
+            try:
+                digest, size = _hash_file(os.path.join(path, name))
+            except OSError as exc:
+                return f"member {name!r} unreadable: {exc}"
+            if size != meta["bytes"]:
+                return (f"member {name!r} truncated: {size} bytes, "
+                        f"manifest says {meta['bytes']}")
+            if digest != meta["digest"]:
+                return f"member {name!r} fails digest verification"
+        return None
+
+    def load(self, number: int) -> Generation:
+        """Verified read of one specific generation (raises on corruption —
+        callers wanting fallback use :meth:`latest_valid`)."""
+        reason = self.verify(number)
+        if reason is not None:
+            raise ValueError(
+                f"generation {number} fails verification: {reason}")
+        path = os.path.join(self.generations_dir, gen_dirname(number))
+        with open(os.path.join(path, MANIFEST_NAME)) as fh:
+            manifest = json.load(fh)
+        return Generation(number=number, path=path, manifest=manifest)
+
+    def latest_valid(self) -> Optional[Generation]:
+        """The newest generation that passes digest verification. Anything
+        newer that fails is quarantined (moved aside + ledger-flagged) so
+        it can never be selected again; None when no valid generation
+        exists."""
+        for number in reversed(self.published()):
+            reason = self.verify(number)
+            if reason is None:
+                return self.load(number)
+            self.quarantine(number, reason)
+        return None
+
+    def quarantine(self, number: int, reason: str) -> None:
+        """Move a corrupt generation out of the selectable set, keeping its
+        bytes for forensics, and record why in the ledger."""
+        src = os.path.join(self.generations_dir, gen_dirname(number))
+        dst = os.path.join(self.quarantine_dir, gen_dirname(number))
+        if os.path.isdir(src):
+            if os.path.isdir(dst):  # name collision from a prior half-move
+                shutil.rmtree(dst, ignore_errors=True)
+            os.replace(src, dst)
+        self._update_ledger(number, status="quarantined", reason=reason,
+                            quarantined_at=time.time())
+
+    # -- retention ------------------------------------------------------
+    def retained(self, numbers: List[int]) -> set:
+        keep = set(numbers[-self.keep_last:])
+        if self.keep_every:
+            keep.update(n for n in numbers if n % self.keep_every == 0)
+        return keep
+
+    def gc(self) -> List[int]:
+        """Apply retention: delete published generations outside
+        keep-last-K / keep-every-N. The ledger entry flips to ``gc``
+        BEFORE the directory is removed — a crash mid-delete leaves a
+        directory the next ``latest_valid`` can still verify (it only
+        shrinks the retained set, never corrupts it)."""
+        numbers = self.published()
+        keep = self.retained(numbers)
+        removed = []
+        for number in numbers:
+            if number in keep:
+                continue
+            self._update_ledger(number, status="gc", gc_at=time.time())
+            shutil.rmtree(
+                os.path.join(self.generations_dir, gen_dirname(number)),
+                ignore_errors=True,
+            )
+            removed.append(number)
+        return removed

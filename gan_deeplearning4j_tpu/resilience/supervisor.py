@@ -1,0 +1,307 @@
+"""TrainingSupervisor — runs ``GanExperiment`` in resumable segments.
+
+The contract (docs/RESILIENCE.md):
+
+- **restore**: every attempt starts from the newest *valid* generation in
+  the :class:`~.store.CheckpointStore` — params, updater state, and the
+  step counter all come back (``GanExperiment.load_models``), and the PRNG
+  stream needs no side file because every per-step key is derived from the
+  carried step counter (``fold_in(base_key, step)``) and the label-noise
+  draws replay deterministically from the config seed at construction;
+- **deterministic data schedule**: the batch for step *i* is a pure
+  function of *i* (sequential slices of the training arrays, wrapping at
+  the epoch boundary) — the property that makes an interrupted-and-resumed
+  run replay the exact minibatch sequence of an uninterrupted one;
+- **bit-exact resume**: the two properties above make resume exact — an
+  interrupted run resumed from any generation produces bit-identical final
+  params to an uninterrupted run of equal total steps (the drill's first
+  invariant, enforced by digest comparison);
+- **fault trapping**: a worker fault (any ``Exception`` out of the training
+  step — including :class:`~.faults.InjectedFault`) abandons the attempt
+  and retries from the newest valid generation with bounded exponential
+  backoff; the retry budget exhausting raises
+  :class:`RetryBudgetExceeded` — a *terminal* error, never a silent loop;
+- **preemption**: SIGTERM (or :meth:`request_preemption`) is honored at
+  the next step boundary by publishing a checkpoint and returning cleanly
+  with ``status="preempted"`` — the worker loses at most the in-flight
+  step;
+- **hard kills** (SIGKILL, machine loss) cannot be trapped in-process; the
+  supervisor's contribution is that the store always holds a consistent
+  generation at most ``publish_every`` steps old, so the *relauncher* (the
+  drill, an orchestrator, a human) recovers by simply starting a new
+  supervisor on the same store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import signal
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from gan_deeplearning4j_tpu.resilience.store import CheckpointStore, tree_digest
+
+logger = logging.getLogger(__name__)
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """Terminal: the configured retries are spent. Carries the last worker
+    fault as ``__cause__``."""
+
+
+class UnsupportedExperimentError(RuntimeError):
+    """Terminal, never retried: the experiment config cannot honor the
+    bit-exact resume contract. The contract rests on every random draw
+    being a pure function of the carried step counter — true on the fused
+    training path (per-step ``fold_in`` keys), false on the phased
+    parameter-averaging path, whose z/label draws come from host-side
+    *sequential* RNGs that a relaunched process cannot fast-forward."""
+
+
+@dataclasses.dataclass
+class SupervisorConfig:
+    """Knobs of the resumable-segment loop (experiment knobs stay on
+    ``ExperimentConfig``)."""
+
+    total_steps: int
+    publish_every: int = 10        # checkpoint cadence, in steps
+    max_retries: int = 3           # worker-fault retries before terminal
+    backoff_base_s: float = 0.5    # retry n sleeps min(base·2^(n-1), max)
+    backoff_max_s: float = 30.0
+    keep_last: int = 3             # store retention: newest K generations
+    keep_every: int = 0            # plus every N-th generation (0 = off)
+
+    def validate(self) -> "SupervisorConfig":
+        if self.total_steps < 1:
+            raise ValueError("total_steps must be >= 1")
+        if self.publish_every < 1:
+            raise ValueError("publish_every must be >= 1")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.backoff_base_s < 0 or self.backoff_max_s < self.backoff_base_s:
+            raise ValueError("need 0 <= backoff_base_s <= backoff_max_s")
+        return self
+
+
+class TrainingSupervisor:
+    """Drives a :class:`GanExperiment` step by step under the fault
+    contract above. ``features``/``labels`` are the full training arrays
+    (the deterministic schedule slices them); ``sleep`` is injectable so
+    tests assert backoff without wall-clock waits; ``experiment_factory``
+    is injectable for fakes."""
+
+    def __init__(self, exp_config, sup_config: SupervisorConfig,
+                 features: np.ndarray, labels: np.ndarray,
+                 store: Optional[CheckpointStore] = None,
+                 store_root: Optional[str] = None,
+                 faults=None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 experiment_factory=None) -> None:
+        self.exp_config = exp_config
+        self.sup = sup_config.validate()
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        b = exp_config.batch_size_train
+        if self.features.shape[0] < b:
+            raise ValueError(
+                f"need at least one full batch: {self.features.shape[0]} "
+                f"rows < batch_size_train {b}")
+        self.batches_per_epoch = self.features.shape[0] // b
+        if store is None:
+            if store_root is None:
+                raise ValueError("pass store= or store_root=")
+            store = CheckpointStore(store_root, keep_last=self.sup.keep_last,
+                                    keep_every=self.sup.keep_every,
+                                    fault_injector=faults)
+        self.store = store
+        self.faults = faults
+        self._sleep = sleep
+        if experiment_factory is None:
+            from gan_deeplearning4j_tpu.harness import GanExperiment
+
+            experiment_factory = GanExperiment
+        self._experiment_factory = experiment_factory
+        self._preempt = False
+        self.retry_delays: List[float] = []
+        self.events: List[dict] = []
+
+    # -- preemption -----------------------------------------------------
+    def request_preemption(self) -> None:
+        """Checkpoint and exit cleanly at the next step boundary."""
+        self._preempt = True
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM-style preemption: the scheduler's grace signal becomes a
+        clean checkpoint-and-exit instead of a dead worker."""
+        def handler(signum, frame):
+            logger.info("signal %d — preemption requested", signum)
+            self.request_preemption()
+
+        signal.signal(signal.SIGTERM, handler)
+
+    # -- deterministic data schedule -------------------------------------
+    def batch_at(self, step: int):
+        """The minibatch for step ``step`` — a pure function of the step
+        counter (sequential full batches, wrapping at the epoch boundary),
+        so resumed and uninterrupted runs replay the same data stream."""
+        b = self.exp_config.batch_size_train
+        p = (step % self.batches_per_epoch) * b
+        return self.features[p:p + b], self.labels[p:p + b]
+
+    # -- state digests ---------------------------------------------------
+    @staticmethod
+    def state_digests(exp) -> dict:
+        """Canonical content digests of every trained state — reproducible
+        across processes (unlike zip bytes), the currency of the drill's
+        bit-exactness check."""
+        out = {
+            "dis": tree_digest(exp.dis_state),
+            "gan": tree_digest(exp.gan_state),
+            "gen": tree_digest(exp.gen_params),
+        }
+        if exp.cv_state is not None:
+            out["CV"] = tree_digest(exp.cv_state)
+        return out
+
+    # -- publish ---------------------------------------------------------
+    def _publish(self, exp) -> dict:
+        t0 = time.perf_counter()
+        digests = self.state_digests(exp)
+        generation = self.store.publish(
+            lambda d: exp.save_models(directory=d),
+            step=exp.batch_counter,
+            extra={"kind": "training", "state_digests": digests},
+        )
+        seconds = time.perf_counter() - t0
+        self.events.append({
+            "event": "publish", "generation": generation.number,
+            "step": exp.batch_counter, "seconds": seconds,
+        })
+        if self.faults is not None:
+            self.faults.on_published(self.store, generation)
+        return {"generation": generation.number, "seconds": seconds,
+                "digests": digests}
+
+    # -- the loop ---------------------------------------------------------
+    def run(self) -> dict:
+        """Run to ``total_steps``, surviving trappable faults. Returns a
+        summary dict (status ``completed`` or ``preempted``); raises
+        :class:`RetryBudgetExceeded` when retries are spent."""
+        attempt = 0
+        self._preempt = False  # a prior preempted run() must not poison this one
+        while True:
+            try:
+                return self._run_attempt(attempt)
+            except UnsupportedExperimentError:
+                raise  # a config error retries into the same wall — terminal
+            except Exception as exc:  # worker fault — retry from the store
+                attempt += 1
+                self.events.append({
+                    "event": "fault", "attempt": attempt,
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
+                if attempt > self.sup.max_retries:
+                    raise RetryBudgetExceeded(
+                        f"retry budget ({self.sup.max_retries}) exhausted; "
+                        f"last fault: {type(exc).__name__}: {exc}"
+                    ) from exc
+                delay = min(self.sup.backoff_max_s,
+                            self.sup.backoff_base_s * 2 ** (attempt - 1))
+                self.retry_delays.append(delay)
+                self.events.append({"event": "retry", "attempt": attempt,
+                                    "backoff_s": delay})
+                logger.warning("worker fault (%s) — retry %d/%d after %.2fs",
+                               exc, attempt, self.sup.max_retries, delay)
+                self._sleep(delay)
+
+    def _run_attempt(self, attempt: int) -> dict:
+        t0 = time.perf_counter()
+        exp = self._experiment_factory(self.exp_config)
+        # the bit-exact contract requires the fused (step-keyed RNG) path:
+        # the phased path draws z/ε from host-side sequential RNGs that
+        # restart from the seed in every relaunched process, so a resumed
+        # run would silently diverge from an uninterrupted one
+        if getattr(exp, "_fused", True) is None:
+            raise UnsupportedExperimentError(
+                "this experiment config trains on the phased "
+                "(parameter-averaging) path, whose host-side sequential RNG "
+                "draws cannot be reconstructed from the step counter — "
+                "bit-exact resume is impossible; use a fused-path config "
+                "(distributed='none' or 'pmean')"
+            )
+        generation = self.store.latest_valid()
+        if generation is not None:
+            exp.load_models(directory=generation.path)
+            self.events.append({
+                "event": "restore", "generation": generation.number,
+                "step": exp.batch_counter, "attempt": attempt,
+            })
+        restore_s = time.perf_counter() - t0
+        start_step = exp.batch_counter
+        last_publish_step = exp.batch_counter if generation is not None else -1
+        train_s = publish_s = 0.0
+        publish_count = 0
+        first_step_s: Optional[float] = None
+        final_publish: Optional[dict] = None
+        if generation is not None:
+            # if nothing remains to train, the restored generation IS final
+            final_publish = {
+                "generation": generation.number, "seconds": 0.0,
+                "digests": generation.manifest.get("state_digests"),
+            }
+
+        def publish() -> None:
+            nonlocal publish_s, publish_count, last_publish_step, final_publish
+            if exp.batch_counter == last_publish_step:
+                return  # this boundary already holds a generation
+            info = self._publish(exp)
+            publish_s += info["seconds"]
+            publish_count += 1
+            last_publish_step = exp.batch_counter
+            final_publish = info
+
+        while exp.batch_counter < self.sup.total_steps:
+            if self._preempt:
+                publish()
+                return self._summary(
+                    "preempted", exp, attempt, start_step, restore_s,
+                    first_step_s, train_s, publish_s, publish_count,
+                    final_publish)
+            if self.faults is not None:
+                self.faults.on_step(exp.batch_counter)
+            feats, labels = self.batch_at(exp.batch_counter)
+            t = time.perf_counter()
+            exp.train_iteration(feats, labels)
+            train_s += time.perf_counter() - t
+            if first_step_s is None:
+                first_step_s = time.perf_counter() - t0
+            exp.batch_counter += 1
+            if exp.batch_counter % self.sup.publish_every == 0:
+                publish()
+        publish()  # final state, even off-cadence
+        return self._summary("completed", exp, attempt, start_step,
+                             restore_s, first_step_s, train_s, publish_s,
+                             publish_count, final_publish)
+
+    def _summary(self, status, exp, attempt, start_step, restore_s,
+                 first_step_s, train_s, publish_s, publish_count,
+                 final_publish) -> dict:
+        return {
+            "status": status,
+            "steps": exp.batch_counter,
+            "total_steps": self.sup.total_steps,
+            "start_step": start_step,
+            "attempts_used": attempt,
+            "retry_delays": list(self.retry_delays),
+            "restore_s": restore_s,
+            "time_to_first_step_s": first_step_s,
+            "train_s": train_s,
+            "publish_s": publish_s,
+            "publish_count": publish_count,
+            "final_generation": (final_publish or {}).get("generation"),
+            "state_digests": (final_publish or {}).get("digests"),
+            "events": list(self.events),
+        }

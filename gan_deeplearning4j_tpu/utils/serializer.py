@@ -20,6 +20,7 @@ export has (SURVEY §3.3 hot loop 3) is avoided at every host boundary here.
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
@@ -32,6 +33,13 @@ import jax.numpy as jnp
 import numpy as np
 
 FORMAT_VERSION = 1
+
+
+def member_digest(data: bytes) -> str:
+    """Content digest of one checkpoint member (``sha256:<hex>``) — the
+    currency of both the in-zip manifest (``meta.json``'s
+    ``member_digests``) and the resilience store's generation manifests."""
+    return "sha256:" + hashlib.sha256(data).hexdigest()
 
 
 def _flatten(prefix: str, tree: Dict, out: Dict[str, np.ndarray]) -> None:
@@ -85,11 +93,21 @@ def write_model(path: str, graph, state, save_updater: bool = True) -> None:
 
     npz_buf = io.BytesIO()
     np.savez(npz_buf, **arrays)
+    topology_bytes = json.dumps(graph.to_dict()).encode()
+    npz_bytes = npz_buf.getvalue()
     meta = {
         "format_version": FORMAT_VERSION,
         "step": int(step) if step is not None else 0,
         "has_updater": opt_state is not None,
         "array_dtypes": ext_dtypes,
+        # per-member content digests: read_model re-hashes every member
+        # against these, so a flipped bit ANYWHERE in the payload — not just
+        # a truncation the zip CRC happens to catch — fails loudly. The
+        # resilience store's corruption quarantine is built on this check.
+        "member_digests": {
+            "topology.json": member_digest(topology_bytes),
+            "arrays.npz": member_digest(npz_bytes),
+        },
     }
 
     directory = os.path.dirname(os.path.abspath(path))
@@ -100,9 +118,9 @@ def write_model(path: str, graph, state, save_updater: bool = True) -> None:
     try:
         with os.fdopen(fd, "wb") as fh:
             with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
-                zf.writestr("topology.json", json.dumps(graph.to_dict()))
+                zf.writestr("topology.json", topology_bytes)
                 zf.writestr("meta.json", json.dumps(meta))
-                zf.writestr("arrays.npz", npz_buf.getvalue())
+                zf.writestr("arrays.npz", npz_bytes)
             # flush to stable storage BEFORE the rename publishes the file:
             # without the fsync a crash can publish a name pointing at
             # not-yet-written bytes — exactly the truncated zip the serving
@@ -127,14 +145,28 @@ def read_model(path: str, load_updater: bool = True) -> Tuple[object, Dict, Opti
 
     try:
         with zipfile.ZipFile(path, "r") as zf:
-            topology = json.loads(zf.read("topology.json"))
+            topology_bytes = zf.read("topology.json")
             meta = json.loads(zf.read("meta.json"))
             if meta["format_version"] > FORMAT_VERSION:
                 raise ValueError(
                     f"checkpoint format {meta['format_version']} is newer than "
                     f"supported {FORMAT_VERSION}"
                 )
-            with np.load(io.BytesIO(zf.read("arrays.npz"))) as npz:
+            npz_bytes = zf.read("arrays.npz")
+            # digest verification (same contract as the truncation checks:
+            # corruption raises ValueError, never a silent partial load).
+            # Checkpoints written before member_digests existed carry no
+            # digests and skip the check.
+            for name, data in (("topology.json", topology_bytes),
+                               ("arrays.npz", npz_bytes)):
+                want = meta.get("member_digests", {}).get(name)
+                if want is not None and member_digest(data) != want:
+                    raise ValueError(
+                        f"checkpoint {path!r} member {name!r} fails digest "
+                        f"verification (expected {want}) — corrupted bytes"
+                    )
+            topology = json.loads(topology_bytes)
+            with np.load(io.BytesIO(npz_bytes)) as npz:
                 flat = {k: npz[k] for k in npz.files}
     except zipfile.BadZipFile as exc:
         raise ValueError(

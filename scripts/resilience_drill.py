@@ -1,0 +1,330 @@
+#!/usr/bin/env python
+"""Resilience drill — kill training mid-run, prove recovery, measure cost.
+
+The drill is the executable form of docs/RESILIENCE.md's invariants. It
+launches the supervised worker (``python -m gan_deeplearning4j_tpu
+.resilience``) as a real subprocess, murders it, relaunches it, corrupts
+its checkpoints, and checks that the resilience layer keeps every promise:
+
+1. **oracle** — an uninterrupted run of ``total_steps`` records the ground
+   truth: final state digests, per-step train time, checkpoint-write
+   overhead.
+2. **kill/recover** — a fresh store; a deterministic (seeded) fault
+   schedule SIGKILLs the worker at step N. The drill observes the death,
+   relaunches (the schedule is handed only to the first launch — the
+   preemption happened once), and measures recovery time, lost steps, and
+   relaunch count.
+3. **bit-exact resume** — the recovered run's final state digests must be
+   IDENTICAL to the oracle's: interrupted-and-resumed == uninterrupted at
+   equal total steps.
+4. **corruption fallback** — the recovered store's newest generation gets
+   its bytes flipped; a further run must quarantine it (ledger status
+   ``quarantined``), restore from the prior generation, and complete.
+
+Results land as a BENCH-style JSON (``--output``, and ``--record TAG``
+additionally writes ``BENCH_resilience_<TAG>.json`` at the repo root).
+Exit status is nonzero on any invariant breach — non-bit-exact resume, a
+corrupt generation selected, the relaunch/retry budget exceeded without a
+terminal error — so CI can gate on the drill directly
+(``scripts/tpu_campaign.sh`` runs ``--smoke`` CPU-pinned as a preflight).
+
+The workload is the tabular family at toy size: the drill proves the
+*mechanism* (processes really die; stores really quarantine), not model
+quality, and must be cheap enough to run as a tier-1 smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+WORKER = [sys.executable, "-m", "gan_deeplearning4j_tpu.resilience"]
+
+
+def log(msg: str) -> None:
+    print(f"[drill] {msg}", flush=True)
+
+
+def make_workload(workdir: str, seed: int) -> dict:
+    """Config + deterministic synthetic data for the drill's tiny tabular
+    GAN. Returns the paths the worker CLI consumes."""
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig
+
+    cfg = ExperimentConfig(
+        model_family="tabular", num_features=16, z_size=4,
+        batch_size_train=8, batch_size_pred=8,
+        height=1, width=1, channels=1,
+        save_models=False, seed=seed, file_prefix="tabular",
+        output_dir=os.path.join(workdir, "out"),
+    )
+    config_path = os.path.join(workdir, "config.json")
+    cfg.to_json(config_path)
+    rng = np.random.default_rng(seed)
+    features = rng.random((64, 16), dtype=np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
+    data_path = os.path.join(workdir, "data.npz")
+    np.savez(data_path, features=features, labels=labels)
+    return {"config": config_path, "data": data_path}
+
+
+def run_worker(workload: dict, store: str, total_steps: int,
+               publish_every: int, summary_path: str,
+               schedule_path: str | None = None,
+               timeout_s: float = 600.0) -> tuple:
+    """One worker lifetime. Returns (returncode, summary_dict_or_None,
+    wall_seconds). A negative returncode is death by signal."""
+    cmd = WORKER + [
+        "--config", workload["config"], "--data", workload["data"],
+        "--store", store,
+        "--total-steps", str(total_steps),
+        "--publish-every", str(publish_every),
+        "--summary", summary_path,
+    ]
+    if schedule_path:
+        cmd += ["--fault-schedule", schedule_path]
+    # Workers run with the persistent XLA compilation cache OFF: the
+    # XLA:CPU AOT loader is unsafe (runtime/environment.py — cpu_aot_loader
+    # errors, SIGILL/heap-corruption risk), and a worker segfaulting on a
+    # poisoned cache entry is indistinguishable from the fault being
+    # drilled — the one contamination this harness cannot tolerate. An
+    # environment that exported GDT_COMPILATION_CACHE (the test suite
+    # does) must not leak it into the workers.
+    env = {**os.environ, "GDT_COMPILATION_CACHE": "off"}
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(cmd, cwd=_REPO, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        # a hung worker is an invariant failure to REPORT, not a drill
+        # crash: rc=None flows through the phase logic as "unexpected exit"
+        log(f"worker hung past {timeout_s:.0f}s — killed")
+        return None, None, time.perf_counter() - t0
+    wall = time.perf_counter() - t0
+    summary = None
+    if os.path.exists(summary_path):
+        try:
+            with open(summary_path) as fh:
+                summary = json.load(fh)
+        except json.JSONDecodeError:
+            summary = None  # torn write from a killed worker — expected
+    if proc.returncode not in (0, 75) and proc.returncode >= 0:
+        log(f"worker rc={proc.returncode} stderr tail: "
+            f"{proc.stderr[-500:]}")
+    return proc.returncode, summary, wall  # negative rc = death by signal
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="tier-1 shape: 12 steps, publish every 3")
+    p.add_argument("--total-steps", type=int, default=None)
+    p.add_argument("--publish-every", type=int, default=None)
+    p.add_argument("--kill-step", type=int, default=None,
+                   help="override the seeded kill step")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--relaunch-budget", type=int, default=5)
+    p.add_argument("--workdir", default=None,
+                   help="keep work files here instead of a temp dir")
+    p.add_argument("--output", default=None, metavar="PATH",
+                   help="write the drill JSON here")
+    p.add_argument("--record", default=None, metavar="TAG",
+                   help="also write BENCH_resilience_<TAG>.json at the "
+                        "repo root")
+    args = p.parse_args(argv)
+
+    total = args.total_steps or (12 if args.smoke else 40)
+    publish_every = args.publish_every or (3 if args.smoke else 5)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="resilience_drill_")
+    cleanup = args.workdir is None
+    os.makedirs(workdir, exist_ok=True)
+
+    from gan_deeplearning4j_tpu.resilience import (
+        CheckpointStore,
+        FaultSchedule,
+        FaultSpec,
+        corrupt_generation,
+    )
+
+    workload = make_workload(workdir, args.seed)
+
+    # the seeded schedule: one SIGKILL somewhere in (0, total)
+    if args.kill_step is not None:
+        kill_step = args.kill_step
+        schedule = FaultSchedule([FaultSpec(kind="kill", step=kill_step)])
+    else:
+        schedule = FaultSchedule.seeded(args.seed, total, kinds=("kill",))
+        kill_step = schedule.specs[0].step
+    schedule_path = os.path.join(workdir, "faults.json")
+    schedule.to_json(schedule_path)
+
+    results: dict = {}
+    invariants: dict = {}
+
+    # -- phase 1: oracle ------------------------------------------------
+    log(f"oracle: {total} uninterrupted steps, publish every {publish_every}")
+    rc, oracle, oracle_wall = run_worker(
+        workload, os.path.join(workdir, "store_oracle"), total,
+        publish_every, os.path.join(workdir, "summary_oracle.json"))
+    if rc != 0 or oracle is None or oracle.get("status") != "completed":
+        log(f"oracle run failed (rc={rc}) — cannot drill")
+        return 2
+    results["oracle"] = {
+        "wall_s": oracle_wall,
+        "train_s": oracle["train_s"],
+        "publish_s": oracle["publish_s"],
+        "publish_count": oracle["publish_count"],
+        "steps": oracle["steps"],
+        "checkpoint_overhead_frac": (
+            oracle["publish_s"] / (oracle["train_s"] + oracle["publish_s"])
+            if oracle["train_s"] + oracle["publish_s"] > 0 else 0.0
+        ),
+        "checkpoint_write_s_mean": (
+            oracle["publish_s"] / oracle["publish_count"]
+            if oracle["publish_count"] else 0.0
+        ),
+    }
+
+    # -- phase 2: kill + relaunch ---------------------------------------
+    fault_store = os.path.join(workdir, "store_fault")
+    log(f"kill/recover: SIGKILL scheduled at step {kill_step}")
+    relaunches = 0
+    killed_observed = False
+    recovery = None
+    final = None
+    while relaunches <= args.relaunch_budget:
+        first = relaunches == 0
+        rc, summary, wall = run_worker(
+            workload, fault_store, total, publish_every,
+            os.path.join(workdir, f"summary_fault_{relaunches}.json"),
+            schedule_path=schedule_path if first else None)
+        if rc == 0 and summary is not None:
+            final = summary
+            if not first and recovery is None:
+                restores = [e for e in summary.get("events", [])
+                            if e.get("event") == "restore"]
+                restored_step = restores[0]["step"] if restores else 0
+                recovery = {
+                    "relaunch_wall_s": wall,
+                    "restore_s": summary.get("restore_s"),
+                    "time_to_first_step_s": summary.get(
+                        "time_to_first_step_s"),
+                    "restored_step": restored_step,
+                    "lost_steps": kill_step - restored_step,
+                }
+            break
+        if rc is not None and rc < 0:
+            killed_observed = True
+            log(f"worker died by signal (rc={rc}) — relaunching")
+            relaunches += 1
+            continue
+        log(f"worker exited rc={rc} unexpectedly — drill failed")
+        break
+    results["kill_recover"] = {
+        "kill_step": kill_step,
+        "killed_observed": killed_observed,
+        "relaunches": relaunches,
+        "recovery": recovery,
+        "completed": final is not None,
+    }
+    invariants["kill_observed"] = killed_observed
+    invariants["recovered_within_budget"] = (
+        final is not None and relaunches <= args.relaunch_budget)
+
+    # -- phase 3: bit-exact resume --------------------------------------
+    oracle_digests = oracle.get("state_digests")
+    final_digests = (final or {}).get("state_digests")
+    invariants["bit_exact_resume"] = (
+        oracle_digests is not None and oracle_digests == final_digests)
+    results["bit_exact"] = {
+        "oracle_digests": oracle_digests,
+        "recovered_digests": final_digests,
+    }
+
+    # -- phase 4: corruption fallback -----------------------------------
+    corrupt_result: dict = {}
+    if final is not None:
+        store = CheckpointStore(fault_store)
+        published = store.published()
+        newest = published[-1]
+        prior = published[-2] if len(published) > 1 else None
+        member = corrupt_generation(store, newest, seed=args.seed)
+        log(f"corrupted generation {newest} member {member!r}; "
+            f"extending run to {total + publish_every} steps")
+        rc, summary, wall = run_worker(
+            workload, fault_store, total + publish_every, publish_every,
+            os.path.join(workdir, "summary_corrupt.json"))
+        restores = [e for e in (summary or {}).get("events", [])
+                    if e.get("event") == "restore"]
+        restored_gen = restores[0]["generation"] if restores else None
+        entry = CheckpointStore(fault_store).entry(newest)
+        corrupt_result = {
+            "corrupted_generation": newest,
+            "corrupted_member": member,
+            "fallback_generation": restored_gen,
+            "ledger_status": entry.get("status"),
+            "quarantine_reason": entry.get("reason"),
+            "completed": rc == 0 and (summary or {}).get("status")
+            == "completed",
+        }
+        invariants["corrupt_never_selected"] = (
+            entry.get("status") == "quarantined"
+            and restored_gen is not None
+            and restored_gen != newest
+            and (prior is None or restored_gen == prior)
+            and corrupt_result["completed"]
+        )
+    else:
+        invariants["corrupt_never_selected"] = False
+    results["corruption"] = corrupt_result
+
+    # -- verdict ---------------------------------------------------------
+    ok = all(invariants.values())
+    payload = {
+        "bench": "resilience_drill",
+        "config": {
+            "total_steps": total,
+            "publish_every": publish_every,
+            "kill_step": kill_step,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "relaunch_budget": args.relaunch_budget,
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "results": results,
+        "invariants": invariants,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.output:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(os.path.join(_REPO,
+                               f"BENCH_resilience_{args.record}.json"),
+                  "w") as fh:
+            fh.write(text + "\n")
+    if cleanup and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        log(f"INVARIANT BREACH — work files kept at {workdir}")
+    for name, good in sorted(invariants.items()):
+        log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,16 @@ if ! JAX_PLATFORMS=cpu timeout 600 python scripts/serve_bench.py --smoke \
   echo "$(date +%H:%M:%S) serve_bench smoke failed — campaign aborted (see serve_bench_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Resilience smoke (CPU, subprocess kill drill): the campaign's long runs
+# survive preemption only if the supervisor/store contract holds — refuse
+# to start if bit-exact resume, corruption quarantine, or the relaunch
+# budget regressed (enforced by the drill's own exit code). Pinned to CPU
+# so it never touches the chip the campaign is about to hold.
+if ! JAX_PLATFORMS=cpu timeout 600 python scripts/resilience_drill.py --smoke \
+    --output artifacts/resilience_smoke.json > resilience_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) resilience drill smoke failed — campaign aborted (see resilience_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

@@ -1225,6 +1225,203 @@ class TestMeshAxisMismatch:
 
 
 # ===========================================================================
+# JG014 — cross-module PRNG key reuse (consumes the index's prng_params)
+# ===========================================================================
+
+_JG014_HELPERS = (
+    "import jax\n"
+    "def sample_z(key, n):\n"
+    "    return jax.random.uniform(key, (n, 2))\n"
+    "def derive_only(key, i):\n"
+    "    return jax.random.fold_in(key, i)\n"
+    "def outer(rng, n):\n"
+    "    return sample_z(rng, n)\n"  # consumes transitively
+)
+
+
+class TestCrossModulePrngReuse:
+    def test_true_positive_same_key_two_handoffs(self):
+        # the indirection JG001 cannot see: both draws happen a module away
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "from pkg.helpers import sample_z\n"
+                "def run(key, n):\n"
+                "    a = sample_z(key, n)\n"
+                "    b = sample_z(key, n)\n"
+                "    return a, b\n"
+            ),
+        })
+        assert codes(r) == ["JG014"]
+        assert "sample_z" in r.active[0].message
+        assert "already consumed" in r.active[0].message
+
+    def test_true_positive_handoff_then_direct_draw(self):
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "import jax\n"
+                "from pkg.helpers import sample_z\n"
+                "def run(key, n):\n"
+                "    a = sample_z(key, n)\n"
+                "    b = jax.random.normal(key, (n,))\n"
+                "    return a, b\n"
+            ),
+        })
+        assert codes(r) == ["JG014"]
+
+    def test_true_positive_transitive_consumer(self):
+        # outer() only forwards the key — but the forward chain ends in a
+        # jax.random draw, so two outer(key) calls correlate
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "from pkg.helpers import outer\n"
+                "def run(key, n):\n"
+                "    return outer(key, n), outer(key, n)\n"
+            ),
+        })
+        assert codes(r) == ["JG014"]
+
+    def test_true_positive_keyword_handoff(self):
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "from pkg.helpers import sample_z\n"
+                "def run(k2, n):\n"
+                "    a = sample_z(key=k2, n=n)\n"
+                "    b = sample_z(key=k2, n=n)\n"
+                "    return a, b\n"
+            ),
+        })
+        assert codes(r) == ["JG014"]
+
+    def test_true_positive_handoff_loop_replay(self):
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "from pkg.helpers import sample_z\n"
+                "def run(key, n):\n"
+                "    outs = []\n"
+                "    for i in range(4):\n"
+                "        outs.append(sample_z(key, n))\n"
+                "    return outs\n"
+            ),
+        })
+        assert codes(r) == ["JG014"]
+        assert "replays the same stream" in r.active[0].message
+
+    def test_true_negative_split_between_handoffs(self):
+        # the corrected idiom: one subkey per consumer
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "import jax\n"
+                "from pkg.helpers import sample_z\n"
+                "def run(key, n):\n"
+                "    k1, k2 = jax.random.split(key)\n"
+                "    return sample_z(k1, n), sample_z(k2, n)\n"
+            ),
+        })
+        assert codes(r) == []
+
+    def test_true_negative_derive_only_helper(self):
+        # the experiment's wkey idiom: the helper only fold_ins — handing
+        # it the same base key with different salts is the POINT
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "from pkg.helpers import derive_only\n"
+                "def run(key):\n"
+                "    return derive_only(key, 0), derive_only(key, 1)\n"
+            ),
+        })
+        assert codes(r) == []
+
+    def test_true_negative_rebinding_retires_key(self):
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "import jax\n"
+                "from pkg.helpers import sample_z\n"
+                "def run(key, n):\n"
+                "    a = sample_z(key, n)\n"
+                "    key = jax.random.fold_in(key, 1)\n"
+                "    b = sample_z(key, n)\n"
+                "    return a, b\n"
+            ),
+        })
+        assert codes(r) == []
+
+    def test_true_negative_unresolvable_callee_is_silence(self):
+        # callee not in the index: no facts, no guess
+        r = analyze_sources({
+            "pkg/main.py": (
+                "from somewhere_else import sample_z\n"
+                "def run(key, n):\n"
+                "    return sample_z(key, n), sample_z(key, n)\n"
+            ),
+        })
+        assert codes(r) == []
+
+    def test_true_negative_non_prng_param_is_silence(self):
+        # the repeated argument lands on a parameter the summary does NOT
+        # mark PRNG-like — repetition is fine
+        r = analyze_sources({
+            "pkg/helpers.py": (
+                "import jax\n"
+                "def fit(cfg, key):\n"
+                "    return jax.random.normal(key, (cfg,))\n"
+            ),
+            "pkg/main.py": (
+                "import jax\n"
+                "from pkg.helpers import fit\n"
+                "def run(cfg, key):\n"
+                "    k1, k2 = jax.random.split(key)\n"
+                "    return fit(cfg, k1), fit(cfg, k2)\n"
+            ),
+        })
+        assert codes(r) == []
+
+    def test_direct_direct_pairs_stay_jg001(self):
+        # one defect, one code: both uses direct ⇒ JG001 fires, JG014 not
+        r = run(
+            "import jax\n"
+            "def run(key, n):\n"
+            "    a = jax.random.normal(key, (n,))\n"
+            "    b = jax.random.normal(key, (n,))\n"
+            "    return a, b\n"
+        )
+        assert sorted(codes(r)) == ["JG001"]
+
+    def test_skips_test_modules(self):
+        # tests reuse keys deliberately (determinism assertions)
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "tests/test_x.py": (
+                "from pkg.helpers import sample_z\n"
+                "def test_same_key_is_deterministic(key):\n"
+                "    assert (sample_z(key, 3) == sample_z(key, 3)).all()\n"
+            ),
+        })
+        assert "JG014" not in codes(r)
+
+    def test_suppression_applies(self):
+        r = analyze_sources({
+            "pkg/helpers.py": _JG014_HELPERS,
+            "pkg/main.py": (
+                "from pkg.helpers import sample_z\n"
+                "def run(key, n):\n"
+                "    a = sample_z(key, n)\n"
+                "    b = sample_z(key, n)  # jaxlint: disable=JG014\n"
+                "    return a, b\n"
+            ),
+        })
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG014"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

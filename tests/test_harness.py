@@ -383,6 +383,59 @@ class TestCheckpointCadence:
         assert exp4._window_limit(False) == 1
 
 
+class TestEpilogueHook:
+    """run(epilogue_callback=...): fires after every iteration's epilogue
+    with current state (windows pinned to 1); False stops the loop cleanly
+    — the supervision/preemption entry point."""
+
+    def _cfg(self, tmp_path, **overrides):
+        base = dict(
+            model_family="tabular", num_features=16, z_size=4,
+            batch_size_train=8, batch_size_pred=8,
+            height=1, width=1, channels=1, save_models=False,
+            output_dir=str(tmp_path / "out"),
+        )
+        base.update(overrides)
+        return ExperimentConfig(**base)
+
+    def test_fires_every_iteration_with_current_state(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+
+        cfg = self._cfg(tmp_path, num_iterations=5, loss_fetch_every=8)
+        exp = GanExperiment(cfg)
+        feats = exp.family.synthetic_data(40, exp.model_cfg, 0)
+        labels = np.eye(10, dtype=np.float32)[np.arange(40) % 10]
+        seen = []
+
+        def hook(e, index):
+            # the gan step counter must be current at every call (windows
+            # collapse to 1 while a hook is active) AND consistent with
+            # batch_counter — a publishing hook labels checkpoints with it
+            seen.append((index, int(e.gan_state.step)))
+            assert e.batch_counter == index
+
+        it = ArrayDataSetIterator(feats, labels, batch_size=8)
+        result = exp.run(it, epilogue_callback=hook)
+        assert result["iterations"] == 5
+        assert seen == [(i + 1, i + 1) for i in range(5)]
+
+    def test_false_return_stops_cleanly(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+
+        cfg = self._cfg(tmp_path, num_iterations=10)
+        exp = GanExperiment(cfg)
+        feats = exp.family.synthetic_data(80, exp.model_cfg, 0)
+        labels = np.eye(10, dtype=np.float32)[np.arange(80) % 10]
+        it = ArrayDataSetIterator(feats, labels, batch_size=8)
+        result = exp.run(
+            it, epilogue_callback=lambda e, index: index < 3)
+        # the hook returned False at index 3: that iteration completes
+        # (and is counted/logged), nothing after it runs
+        assert result["iterations"] == 3
+        assert exp.batch_counter == 3
+        assert len(result["history"]) == 3
+
+
 class TestResume:
     @pytest.mark.slow
     def test_save_then_load_roundtrip(self, tmp_path):

@@ -1,0 +1,601 @@
+"""resilience/ tests: the generation-ledgered store (publish atomicity,
+digest verification, quarantine, retention GC), the deterministic fault
+plane, the supervisor's resume/preemption/backoff contract — and the CPU
+drill smoke, which kills a real training process at step N and proves
+bit-exact recovery end to end."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.resilience import (
+    CheckpointStore,
+    FaultInjector,
+    FaultSchedule,
+    FaultSpec,
+    InjectedFault,
+    RetryBudgetExceeded,
+    SupervisorConfig,
+    TrainingSupervisor,
+    UnsupportedExperimentError,
+    corrupt_generation,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_persistent_compile_cache():
+    """The XLA:CPU persistent compilation cache's AOT loader is unsafe on
+    CPU (runtime/environment.py documents cpu_aot_loader errors and SIGILL
+    risk; the suite opts in anyway for warm-start speed). This module
+    serially builds and tears down MANY identical fused programs — the
+    write-then-load-in-process pattern that reliably turns the hazard into
+    glibc heap corruption ('corrupted double-linked list' → segfault,
+    reproduced on the seed image). Run the module with the persistent
+    cache off; jax memoizes the cache-used decision, so reset it on both
+    edges."""
+    jax = pytest.importorskip("jax")
+    from jax._src import compilation_cache as _cc
+
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    _cc.reset_cache()  # drop the memoized "cache is used" decision
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+    _cc.reset_cache()
+
+
+def write_files(payload):
+    """A store writer callback that writes a dict of name -> bytes."""
+    def writer(directory):
+        for name, data in payload.items():
+            with open(os.path.join(directory, name), "wb") as fh:
+                fh.write(data)
+    return writer
+
+
+# ===========================================================================
+# CheckpointStore
+# ===========================================================================
+
+class TestStore:
+    def test_publish_and_latest_round_trip(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        g = store.publish(write_files({"a.bin": b"alpha", "b.bin": b"beta"}),
+                          step=7, extra={"state_digests": {"a": "x"}})
+        assert g.number == 0 and g.step == 7
+        latest = store.latest_valid()
+        assert latest is not None and latest.number == 0
+        assert open(latest.file("a.bin"), "rb").read() == b"alpha"
+        assert latest.manifest["state_digests"] == {"a": "x"}
+        assert store.entry(0)["status"] == "published"
+        # no staging leftovers after a clean publish
+        assert not [n for n in os.listdir(tmp_path) if n.startswith(".stage")]
+
+    def test_empty_generation_rejected(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        with pytest.raises(ValueError, match="no files"):
+            store.publish(lambda d: None, step=0)
+        assert store.published() == []
+
+    def test_corrupt_latest_quarantined_and_prior_served(self, tmp_path):
+        """The headline invariant: a corrupted newest generation is never
+        selected — it moves to quarantine/ with a ledger reason and the
+        walk falls back to the prior generation."""
+        store = CheckpointStore(str(tmp_path))
+        store.publish(write_files({"m.bin": b"one" * 100}), step=1)
+        store.publish(write_files({"m.bin": b"two" * 100}), step=2)
+        corrupt_generation(store, 1)
+        latest = store.latest_valid()
+        assert latest.number == 0 and latest.step == 1
+        assert store.quarantined() == [1]
+        entry = store.entry(1)
+        assert entry["status"] == "quarantined"
+        assert "digest" in entry["reason"]
+        # the quarantined generation is out of the selectable set for good
+        assert store.published() == [0]
+
+    def test_two_corrupt_generations_fall_through(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=3)
+        for step in (1, 2, 3):
+            store.publish(write_files({"m.bin": bytes(100) + bytes([step])}),
+                          step=step)
+        corrupt_generation(store, 1)
+        corrupt_generation(store, 2)
+        latest = store.latest_valid()
+        assert latest.number == 0
+        assert store.quarantined() == [1, 2]
+
+    def test_truncation_detected(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.publish(write_files({"m.bin": b"x" * 1000}), step=1)
+        path = os.path.join(store.generations_dir, "gen-00000000", "m.bin")
+        with open(path, "r+b") as fh:
+            fh.truncate(500)
+        assert "truncated" in store.verify(0)
+        assert store.latest_valid() is None
+        assert store.entry(0)["status"] == "quarantined"
+
+    def test_missing_member_detected(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.publish(write_files({"m.bin": b"x"}), step=1)
+        os.unlink(os.path.join(store.generations_dir, "gen-00000000",
+                               "m.bin"))
+        assert "unreadable" in store.verify(0)
+
+    def test_load_raises_on_corruption(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.publish(write_files({"m.bin": b"y" * 64}), step=1)
+        corrupt_generation(store, 0)
+        with pytest.raises(ValueError, match="verification"):
+            store.load(0)
+
+    def test_gc_keep_last(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=2)
+        for step in range(5):
+            store.publish(write_files({"m.bin": bytes([step]) * 10}),
+                          step=step)
+        assert store.published() == [3, 4]
+        assert store.entry(0)["status"] == "gc"
+        # the ledger remembers everything ever published
+        assert sorted(int(k) for k in store.ledger()["entries"]) == list(
+            range(5))
+
+    def test_gc_keep_every(self, tmp_path):
+        # keep-every-N pins archival generations that outlive keep-last
+        store = CheckpointStore(str(tmp_path), keep_last=2, keep_every=3)
+        for step in range(8):
+            store.publish(write_files({"m.bin": bytes([step]) * 10}),
+                          step=step)
+        # 0, 3, 6 survive via keep_every; 6, 7 via keep_last
+        assert store.published() == [0, 3, 6, 7]
+
+    def test_numbering_monotonic_after_gc_and_quarantine(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=1)
+        for step in range(3):
+            store.publish(write_files({"m.bin": bytes([step])}), step=step)
+        assert store.published() == [2]
+        corrupt_generation(store, 2)
+        assert store.latest_valid() is None  # 2 quarantined, 0/1 gc'd
+        g = store.publish(write_files({"m.bin": b"new"}), step=9)
+        assert g.number == 3  # never reuses a number
+
+    def test_stale_staging_swept_on_construction(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        crash_dir = os.path.join(str(tmp_path), ".stage-gen-00000000-999")
+        os.makedirs(crash_dir)
+        with open(os.path.join(crash_dir, "half.bin"), "wb") as fh:
+            fh.write(b"partial")
+        CheckpointStore(str(tmp_path))  # reopening sweeps
+        assert not os.path.exists(crash_dir)
+
+    def test_torn_ledger_recovers_from_dir_scan(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.publish(write_files({"m.bin": b"ok"}), step=1)
+        with open(store.ledger_path, "w") as fh:
+            fh.write("{not json")
+        reopened = CheckpointStore(str(tmp_path))
+        assert reopened.latest_valid().number == 0
+        assert reopened.next_number() == 1
+
+    def test_failed_writer_leaves_no_trace(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+
+        def bad(directory):
+            with open(os.path.join(directory, "a.bin"), "wb") as fh:
+                fh.write(b"x")
+            raise OSError("disk full")
+
+        with pytest.raises(OSError):
+            store.publish(bad, step=1)
+        assert store.published() == []
+        assert not [n for n in os.listdir(tmp_path) if n.startswith(".stage")]
+
+    def test_retention_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            CheckpointStore(str(tmp_path), keep_last=0)
+        with pytest.raises(ValueError):
+            CheckpointStore(str(tmp_path), keep_every=-1)
+
+
+# ===========================================================================
+# faults
+# ===========================================================================
+
+class TestFaults:
+    def test_seeded_schedule_is_deterministic(self):
+        a = FaultSchedule.seeded(42, 100, kinds=("raise", "kill"), n_faults=3)
+        b = FaultSchedule.seeded(42, 100, kinds=("raise", "kill"), n_faults=3)
+        assert a == b
+        assert len(a.specs) == 3
+        assert all(1 <= s.step < 100 for s in a.specs)
+        c = FaultSchedule.seeded(43, 100, kinds=("raise", "kill"), n_faults=3)
+        assert a != c
+
+    def test_schedule_json_round_trip(self, tmp_path):
+        sched = FaultSchedule([
+            FaultSpec(kind="kill", step=5),
+            FaultSpec(kind="slow_write", step=2, args={"seconds": 0.5}),
+        ])
+        path = os.path.join(tmp_path, "f.json")
+        sched.to_json(path)
+        assert FaultSchedule.from_json(path) == sched
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec(kind="meteor", step=1)
+
+    def test_raise_fires_once_at_exact_step(self):
+        inj = FaultInjector(FaultSchedule([FaultSpec(kind="raise", step=3)]))
+        inj.on_step(2)
+        with pytest.raises(InjectedFault):
+            inj.on_step(3)
+        inj.on_step(3)  # already fired — never twice
+        assert [e["kind"] for e in inj.log] == ["raise"]
+
+    def test_slow_and_failed_write(self, tmp_path):
+        sleeps = []
+        inj = FaultInjector(
+            FaultSchedule([
+                FaultSpec(kind="slow_write", step=2, args={"seconds": 1.5}),
+                FaultSpec(kind="fail_write", step=4),
+            ]),
+            sleep=sleeps.append,
+        )
+        store = CheckpointStore(str(tmp_path), fault_injector=inj)
+        store.publish(write_files({"m.bin": b"a"}), step=0)  # before both
+        store.publish(write_files({"m.bin": b"b"}), step=3)  # slow fires
+        assert sleeps == [1.5]
+        with pytest.raises(OSError, match="injected"):
+            store.publish(write_files({"m.bin": b"c"}), step=5)
+        # the failed publish left no half-generation behind
+        assert store.published() == [0, 1]
+        assert store.latest_valid().number == 1
+
+    def test_corrupt_on_published(self, tmp_path):
+        inj = FaultInjector(
+            FaultSchedule([FaultSpec(kind="corrupt", step=1)]))
+        store = CheckpointStore(str(tmp_path))
+        g = store.publish(write_files({"m.bin": b"q" * 64}), step=2)
+        inj.on_published(store, g)
+        assert store.verify(g.number) is not None
+        assert inj.log[-1]["member"] == "m.bin"
+
+
+# ===========================================================================
+# supervisor — fast paths with a fake experiment (no jax)
+# ===========================================================================
+
+class FakeExperiment:
+    """Counts steps; never touches jax. save/load shuttle the counter
+    through a text file so restore semantics are exercised for real."""
+
+    instances = []
+
+    def __init__(self, config):
+        self.config = config
+        self.batch_counter = 0
+        self.trained = []
+        FakeExperiment.instances.append(self)
+        self.dis_state = self.gan_state = None
+        self.cv_state = None
+        self.gen_params = None
+
+    def train_iteration(self, feats, labels):
+        self.trained.append(self.batch_counter)
+
+    def save_models(self, directory=None):
+        with open(os.path.join(directory, "state.txt"), "w") as fh:
+            fh.write(str(self.batch_counter))
+
+    def load_models(self, directory=None):
+        with open(os.path.join(directory, "state.txt")) as fh:
+            self.batch_counter = int(fh.read())
+        return self.batch_counter
+
+
+@pytest.fixture(autouse=True)
+def _reset_fakes():
+    FakeExperiment.instances = []
+    yield
+
+
+def fake_supervisor(tmp_path, sup_cfg, faults=None, sleeps=None):
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Cfg:
+        batch_size_train: int = 4
+
+    feats = np.zeros((16, 3), np.float32)
+    labels = np.zeros((16, 2), np.float32)
+    sup = TrainingSupervisor(
+        Cfg(), sup_cfg, feats, labels,
+        store_root=os.path.join(str(tmp_path), "store"),
+        faults=faults,
+        sleep=(sleeps.append if sleeps is not None else (lambda s: None)),
+        experiment_factory=FakeExperiment,
+    )
+    # the fake has no states to digest — bypass the digest hook
+    sup.state_digests = lambda exp: {"fake": str(exp.batch_counter)}
+    return sup
+
+
+class TestSupervisorFast:
+    def test_segments_and_publish_cadence(self, tmp_path):
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=10, publish_every=4))
+        out = sup.run()
+        assert out["status"] == "completed" and out["steps"] == 10
+        # boundaries 4, 8 plus the off-cadence final state at 10
+        assert [e["step"] for e in sup.events
+                if e["event"] == "publish"] == [4, 8, 10]
+        assert sup.store.latest_valid().step == 10
+
+    def test_fault_retry_restores_from_newest_valid(self, tmp_path):
+        inj = FaultInjector(FaultSchedule([FaultSpec(kind="raise", step=6)]))
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=10, publish_every=4),
+            faults=inj)
+        out = sup.run()
+        assert out["status"] == "completed" and out["attempts_used"] == 1
+        restores = [e for e in sup.events if e["event"] == "restore"]
+        assert [r["step"] for r in restores] == [4]
+        # attempt 2 replayed steps 4 and 5 (lost to the fault at 6)
+        second = FakeExperiment.instances[-1]
+        assert second.trained[:3] == [4, 5, 6]
+
+    def test_retry_budget_exhaustion_is_terminal(self, tmp_path):
+        # a fault that keeps firing: every attempt dies at its first step
+        inj = FaultInjector(FaultSchedule(
+            [FaultSpec(kind="raise", step=0) for _ in range(10)]))
+        sleeps = []
+        sup = fake_supervisor(
+            tmp_path,
+            SupervisorConfig(total_steps=5, publish_every=2, max_retries=3,
+                             backoff_base_s=0.5, backoff_max_s=1.5),
+            faults=inj, sleeps=sleeps)
+        with pytest.raises(RetryBudgetExceeded, match="injected"):
+            sup.run()
+        # bounded exponential backoff: 0.5, 1.0, then capped at 1.5
+        assert sleeps == [0.5, 1.0, 1.5]
+        assert len([e for e in sup.events if e["event"] == "fault"]) == 4
+
+    def test_preemption_checkpoints_then_exits_cleanly(self, tmp_path):
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=100, publish_every=50))
+
+        class PreemptAt:
+            def __init__(self, step):
+                self.step = step
+
+            def on_step(self, step):
+                if step == self.step:
+                    sup.request_preemption()
+
+            def on_published(self, store, generation):
+                pass
+
+        sup.faults = PreemptAt(7)
+        out = sup.run()
+        # the preemption flag is honored at the NEXT boundary: step 7 still
+        # trains, then the supervisor publishes and exits
+        assert out["status"] == "preempted"
+        assert out["steps"] == 8
+        assert sup.store.latest_valid().step == 8
+
+    def test_sigterm_preemption_via_real_signal(self, tmp_path):
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=100, publish_every=50))
+        inj = FaultInjector(FaultSchedule(
+            [FaultSpec(kind="preempt", step=5)]))
+        sup.faults = inj
+        old = signal.getsignal(signal.SIGTERM)
+        try:
+            sup.install_signal_handlers()
+            out = sup.run()
+        finally:
+            signal.signal(signal.SIGTERM, old)
+        assert out["status"] == "preempted"
+        assert out["steps"] == 6
+        assert sup.store.latest_valid().step == 6
+
+    def test_phased_experiment_rejected_terminally(self, tmp_path):
+        """The bit-exact contract requires the fused (step-keyed RNG)
+        path: an experiment on the phased param-averaging path (host-side
+        sequential RNG draws) is rejected with a terminal error — never
+        retried into the same wall."""
+        sleeps = []
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=5, max_retries=3),
+            sleeps=sleeps)
+
+        def phased_factory(cfg):
+            exp = FakeExperiment(cfg)
+            exp._fused = None  # the phased-path marker
+            return exp
+
+        sup._experiment_factory = phased_factory
+        with pytest.raises(UnsupportedExperimentError, match="phased"):
+            sup.run()
+        assert sleeps == []  # terminal: no backoff, no retries
+
+    def test_preempt_flag_resets_between_runs(self, tmp_path):
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=6, publish_every=3))
+        sup.request_preemption()
+        out = sup.run()
+        # the stale flag from before run() must not poison the fresh run
+        assert out["status"] == "completed" and out["steps"] == 6
+
+    def test_resume_skips_when_nothing_remains(self, tmp_path):
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=6, publish_every=3))
+        out = sup.run()
+        assert out["steps"] == 6
+        sup2 = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=6, publish_every=3))
+        out2 = sup2.run()
+        assert out2["status"] == "completed" and out2["start_step"] == 6
+        assert out2["final_generation"] == out["final_generation"]
+
+    def test_batch_schedule_is_pure_function_of_step(self, tmp_path):
+        sup = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=4, publish_every=4))
+        f0, _ = sup.batch_at(0)
+        f4, _ = sup.batch_at(4)  # 16 rows / 4 per batch → wraps at 4
+        np.testing.assert_array_equal(f0, f4)
+        f1, _ = sup.batch_at(1)
+        assert f1.shape == f0.shape
+        sup2 = fake_supervisor(
+            tmp_path, SupervisorConfig(total_steps=4, publish_every=4))
+        np.testing.assert_array_equal(f0, sup2.batch_at(0)[0])
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SupervisorConfig(total_steps=0).validate()
+        with pytest.raises(ValueError):
+            SupervisorConfig(total_steps=1, publish_every=0).validate()
+        with pytest.raises(ValueError):
+            SupervisorConfig(total_steps=1, backoff_base_s=2.0,
+                             backoff_max_s=1.0).validate()
+
+
+# ===========================================================================
+# supervisor — real GanExperiment (tabular tiny): the bit-exact contract
+# ===========================================================================
+
+def tabular_cfg(tmp_path):
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig
+
+    return ExperimentConfig(
+        model_family="tabular", num_features=16, z_size=4,
+        batch_size_train=8, batch_size_pred=8, height=1, width=1, channels=1,
+        save_models=False, output_dir=os.path.join(str(tmp_path), "out"),
+    )
+
+
+def tabular_data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = rng.random((n, 16), dtype=np.float32)
+    labels = np.eye(10, dtype=np.float32)[np.arange(n) % 10]
+    return feats, labels
+
+
+class TestSupervisorBitExact:
+    def test_interrupted_resume_is_bit_exact(self, tmp_path):
+        """The drill's core invariant, in-process: a run killed (trappable
+        fault) at step 6 and resumed from the step-4 generation finishes
+        with state digests IDENTICAL to an uninterrupted run of equal
+        total steps."""
+        feats, labels = tabular_data()
+        cfg = tabular_cfg(tmp_path)
+        oracle = TrainingSupervisor(
+            cfg, SupervisorConfig(total_steps=8, publish_every=4),
+            feats, labels,
+            store_root=os.path.join(str(tmp_path), "s_oracle"))
+        r1 = oracle.run()
+        assert r1["status"] == "completed"
+
+        inj = FaultInjector(FaultSchedule([FaultSpec(kind="raise", step=6)]))
+        faulted = TrainingSupervisor(
+            cfg, SupervisorConfig(total_steps=8, publish_every=4,
+                                  backoff_base_s=0.0),
+            feats, labels,
+            store_root=os.path.join(str(tmp_path), "s_fault"),
+            faults=inj, sleep=lambda s: None)
+        r2 = faulted.run()
+        assert r2["status"] == "completed" and r2["attempts_used"] == 1
+        assert r1["state_digests"] == r2["state_digests"]
+        # and the digests cover every trained state
+        assert set(r1["state_digests"]) == {"dis", "gan", "gen"}
+
+    def test_corrupt_generation_falls_back_and_still_completes(self, tmp_path):
+        feats, labels = tabular_data()
+        cfg = tabular_cfg(tmp_path)
+        root = os.path.join(str(tmp_path), "s")
+        first = TrainingSupervisor(
+            cfg, SupervisorConfig(total_steps=6, publish_every=3),
+            feats, labels, store_root=root)
+        first.run()
+        store = CheckpointStore(root)
+        newest = store.published()[-1]
+        corrupt_generation(store, newest)
+        resumed = TrainingSupervisor(
+            cfg, SupervisorConfig(total_steps=9, publish_every=3),
+            feats, labels, store=CheckpointStore(root))
+        out = resumed.run()
+        assert out["status"] == "completed" and out["steps"] == 9
+        restores = [e for e in resumed.events if e["event"] == "restore"]
+        assert restores and restores[0]["generation"] != newest
+        assert CheckpointStore(root).entry(newest)["status"] == "quarantined"
+
+
+# ===========================================================================
+# publish_for_serving into a store generation (versioned serving source)
+# ===========================================================================
+
+class TestServingGeneration:
+    def test_bundle_publishes_as_generation(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+
+        exp = GanExperiment(tabular_cfg(tmp_path))
+        store = CheckpointStore(os.path.join(str(tmp_path), "store"))
+        out = exp.publish_for_serving(store=store)
+        assert out["generation"] == 0
+        gen = store.latest_valid()
+        assert gen is not None and gen.manifest["kind"] == "serving"
+        with open(gen.file("serving.json")) as fh:
+            manifest = json.load(fh)
+        assert manifest["generation"] == 0
+        assert manifest["generator"] in gen.manifest["files"]
+        # a second publish gets the next number
+        out2 = exp.publish_for_serving(store=store)
+        assert out2["generation"] == 1
+
+    def test_directory_publish_is_unversioned(self, tmp_path):
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+
+        exp = GanExperiment(tabular_cfg(tmp_path))
+        out = exp.publish_for_serving(
+            directory=os.path.join(str(tmp_path), "serving"))
+        assert out["generation"] is None
+        with open(os.path.join(out["directory"], "serving.json")) as fh:
+            assert json.load(fh)["generation"] is None
+
+
+# ===========================================================================
+# the drill smoke — a real kill at step N, tier-1 on CPU
+# ===========================================================================
+
+class TestDrillSmoke:
+    def test_drill_smoke_with_injected_kill(self, tmp_path):
+        """End to end through real processes: SIGKILL at the scheduled
+        step, relaunch, bit-exact recovery, corruption quarantine — the
+        drill's own invariants gate its exit code."""
+        out_json = os.path.join(str(tmp_path), "drill.json")
+        proc = subprocess.run(
+            [sys.executable, "scripts/resilience_drill.py", "--smoke",
+             "--workdir", os.path.join(str(tmp_path), "work"),
+             "--output", out_json],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=560,
+        )
+        assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-2000:])
+        with open(out_json) as fh:
+            payload = json.load(fh)
+        assert payload["ok"] is True
+        inv = payload["invariants"]
+        assert inv["kill_observed"] and inv["bit_exact_resume"]
+        assert inv["corrupt_never_selected"] and inv["recovered_within_budget"]
+        results = payload["results"]
+        assert results["kill_recover"]["completed"]
+        assert results["oracle"]["publish_count"] >= 3
+        assert results["oracle"]["checkpoint_overhead_frac"] < 1.0

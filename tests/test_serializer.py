@@ -161,6 +161,52 @@ class TestSerializer:
             with pytest.raises(ValueError, match="corrupt|truncat|missing"):
                 read_model(bad)
 
+    def test_member_digest_mismatch_rejected(self, tmp_path):
+        """Per-member content digests (resilience PR): a member whose bytes
+        were swapped for OTHER valid bytes — same zip structure, CRCs
+        consistent — still fails the digest check from meta.json. This is
+        the corruption class a truncation check can never see."""
+        import json
+        import zipfile
+
+        gen = build_generator()
+        path = os.path.join(tmp_path, "p.zip")
+        write_model(path, gen, gen.init())
+        with zipfile.ZipFile(path) as zf:
+            assert "member_digests" in json.loads(zf.read("meta.json"))
+        bad = os.path.join(tmp_path, "tampered.zip")
+        with zipfile.ZipFile(path) as zin, zipfile.ZipFile(bad, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == "topology.json":
+                    # a valid but different topology payload
+                    data = data[:-1] + b" " + data[-1:]
+                zout.writestr(name, data)
+        with pytest.raises(ValueError, match="digest"):
+            read_model(bad)
+
+    def test_pre_digest_checkpoints_still_load(self, tmp_path):
+        """Backward compatibility: a checkpoint written before
+        member_digests existed (no key in meta.json) loads fine."""
+        import json
+        import zipfile
+
+        gen = build_generator()
+        params = gen.init()
+        path = os.path.join(tmp_path, "p.zip")
+        write_model(path, gen, params)
+        old = os.path.join(tmp_path, "old.zip")
+        with zipfile.ZipFile(path) as zin, zipfile.ZipFile(old, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == "meta.json":
+                    meta = json.loads(data)
+                    del meta["member_digests"]
+                    data = json.dumps(meta).encode()
+                zout.writestr(name, data)
+        _, params2, _, _ = read_model(old)
+        assert_trees_equal(params, params2)
+
     def test_garbage_file_rejected(self, tmp_path):
         bad = os.path.join(tmp_path, "junk.zip")
         with open(bad, "wb") as fh:
