@@ -26,6 +26,9 @@ from gan_deeplearning4j_tpu.analysis.rules.axes import AxisSizeMismatch
 from gan_deeplearning4j_tpu.analysis.rules.sharding import DeadDonatedOutSharding
 from gan_deeplearning4j_tpu.analysis.rules.mesh_axes import MeshAxisMismatch
 from gan_deeplearning4j_tpu.analysis.rules.prng_flow import CrossModulePrngReuse
+from gan_deeplearning4j_tpu.analysis.rules.telemetry_fence import (
+    TelemetryUnfencedTiming,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -42,6 +45,7 @@ RULES = [
     DeadDonatedOutSharding(),
     MeshAxisMismatch(),
     CrossModulePrngReuse(),
+    TelemetryUnfencedTiming(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

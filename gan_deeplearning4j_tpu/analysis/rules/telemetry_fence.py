@@ -1,0 +1,216 @@
+"""JG015 — unfenced wall-clock delta fed to a telemetry sink.
+
+The telemetry plane (docs/OBSERVABILITY.md) makes it one line to record a
+duration: ``hist.observe(time.perf_counter() - t0)``,
+``stats.add("device", dt)``. That convenience revives the repo's oldest
+measurement bug in a new place: XLA dispatch is ASYNCHRONOUS, so a
+perf-counter delta taken around a jitted call without a device fence
+measures dispatch latency, not execution — and unlike a wrong log line, a
+wrong histogram is *load-bearing*: it lands in ``/metrics``, Prometheus
+scrapes, BENCH artifacts, and the routing/reload decisions built on them.
+JG002 polices stale fences in timed loops; this rule extends the same
+fence analysis to the telemetry API: a clock delta that (a) brackets a
+call known to be jit/pmap/shard_map-traced (project-index summaries, a
+local ``f = jax.jit(...)`` binding, or a direct ``jax.jit(fn)(x)``),
+(b) reaches a metrics sink (``.observe(...)``/``.add(...)``/
+``.record(...)``/``.set(...)``), and (c) sees no fence on the traced
+call's output (``block_until_ready``, ``device_get``, ``np.asarray``,
+``.item()``) between the call and the second clock read — is flagged.
+
+True negatives the fixtures pin: fenced deltas (the PhaseTimer sink-list
+idiom), deltas around non-traced work (the store's fsync-bound publish),
+and deltas that only land in plain dicts/lists (summaries are not
+scrape sinks — JG009/JG002 own the general cases).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+_CLOCKS = _common.CLOCK_CALLS
+_SINK_METHODS = {"observe", "add", "record", "set"}
+_FENCE_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "jax.block_until_ready", "jax.device_get",
+}
+_FENCE_METHODS = {"block_until_ready", "item"}
+
+
+def _is_clock_call(node, mod) -> bool:
+    return (isinstance(node, ast.Call)
+            and mod.resolve(node.func) in _CLOCKS)
+
+
+def _clock_delta_names(expr: ast.AST, clock_names: Set[str], mod
+                       ) -> Optional[Set[str]]:
+    """The t0-style names a ``clock() - t0`` expression closes over, or
+    None when ``expr`` is not a clock delta."""
+    if not isinstance(expr, ast.BinOp) or not isinstance(expr.op, ast.Sub):
+        return None
+    if not _is_clock_call(expr.left, mod):
+        return None
+    read = _common.loaded_names(expr.right) & clock_names
+    return read or None
+
+
+def _fence_read_names(call: ast.Call, mod) -> Optional[Set[str]]:
+    resolved = mod.resolve(call.func)
+    if resolved in _FENCE_CALLS:
+        names: Set[str] = set()
+        for arg in call.args:
+            names |= _common.loaded_names(arg)
+        return names
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _FENCE_METHODS and not call.args):
+        return _common.loaded_names(call.func.value)
+    return None
+
+
+class TelemetryUnfencedTiming:
+    code = "JG015"
+    name = "telemetry-unfenced-timing"
+    summary = ("clock delta around a jitted call feeds a telemetry sink "
+               "without a device fence — the metric records dispatch, "
+               "not execution")
+    skip_tests = True
+
+    def check(self, mod):
+        jitted_locals = self._jitted_names(mod)
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if not body:
+                continue
+            yield from self._check_scope(mod, body, jitted_locals)
+
+    # -- what counts as "a jitted call" -------------------------------------
+    def _jitted_names(self, mod) -> Set[str]:
+        """Names bound (anywhere in the module) to the result of a tracing
+        wrapper: ``step = jax.jit(fn)`` — callable later as ``step(x)``."""
+        names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if (isinstance(value, ast.Call)
+                    and mod.resolve(value.func) in _common.TRACING_WRAPPERS):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        names.add(target.id)
+        return names
+
+    def _traced_call(self, call: ast.Call, mod, jitted_locals) -> bool:
+        # direct jax.jit(fn)(x)
+        if (isinstance(call.func, ast.Call)
+                and mod.resolve(call.func.func) in _common.TRACING_WRAPPERS):
+            return True
+        if isinstance(call.func, ast.Name) and call.func.id in jitted_locals:
+            return True
+        if mod.project is not None:
+            summary = mod.project.resolve_function(mod, call.func)
+            if summary is not None and summary.traced:
+                return True
+        return False
+
+    # -- the per-scope dataflow ---------------------------------------------
+    def _check_scope(self, mod, body, jitted_locals):
+        # walk the scope once, excluding nested defs (their own scopes)
+        nodes = list(_common.walk_excluding_defs(body))
+        calls = [n for n in nodes if isinstance(n, ast.Call)]
+
+        # 1. clock origin assignments: t0 = time.perf_counter()
+        clock_names: Dict[str, int] = {}
+        for n in nodes:
+            if isinstance(n, ast.Assign) and _is_clock_call(n.value, mod):
+                for target in n.targets:
+                    if isinstance(target, ast.Name):
+                        clock_names[target.id] = n.lineno
+
+        # 2. delta bindings: dt = clock() - t0 (the sink may consume the
+        #    name instead of the expression)
+        delta_vars: Dict[str, ast.AST] = {}
+        for n in nodes:
+            if isinstance(n, ast.Assign):
+                if _clock_delta_names(n.value, set(clock_names), mod):
+                    for target in n.targets:
+                        if isinstance(target, ast.Name):
+                            delta_vars[target.id] = n.value
+
+        # 3. sink calls whose argument is a clock delta (inline or named)
+        for call in calls:
+            if not (isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _SINK_METHODS):
+                continue
+            for arg in call.args:
+                delta = None
+                if _clock_delta_names(arg, set(clock_names), mod):
+                    delta = arg
+                elif (isinstance(arg, ast.Name) and arg.id in delta_vars):
+                    delta = delta_vars[arg.id]
+                if delta is None:
+                    continue
+                origins = _clock_delta_names(delta, set(clock_names), mod)
+                t0_line = min(clock_names[name] for name in origins)
+                finding = self._judge(
+                    mod, calls, call, delta, t0_line, jitted_locals)
+                if finding is not None:
+                    yield finding
+
+    def _judge(self, mod, calls, sink, delta, t0_line, jitted_locals):
+        """Flag when a traced call sits inside the [t0, delta] window with
+        no fence on its output before the window closes."""
+        end_line = delta.lineno
+        traced = [
+            c for c in calls
+            if t0_line < c.lineno <= end_line
+            and self._traced_call(c, mod, jitted_locals)
+        ]
+        if not traced:
+            return None
+        # names bound from the traced calls — what a fence must read
+        out_names: Set[str] = set()
+        for c in traced:
+            out_names |= self._bound_from(c, mod)
+        for c in calls:
+            # the fence must land BEFORE the second clock read (end_line):
+            # a fence after the delta is computed cannot un-poison it, even
+            # if it runs before the sink call
+            if not t0_line < c.lineno <= end_line:
+                continue
+            read = _fence_read_names(c, mod)
+            if read is None:
+                continue
+            if (read & out_names) or any(
+                    t in ast.walk(c) for t in traced):
+                return None  # fenced: np.asarray(out) / jitted call inline
+        call_text = ast.unparse(traced[0].func)[:40]
+        return mod.finding(
+            self.code,
+            f"`{ast.unparse(sink.func)[:48]}` records a wall-clock delta "
+            f"taken around the jitted call `{call_text}(...)` with no "
+            f"device fence on its output — XLA dispatch is async, so the "
+            f"metric measures dispatch, not execution; fence with "
+            f"`jax.block_until_ready(...)`/`np.asarray(...)` before the "
+            f"second clock read (JG002's contract, extended to telemetry "
+            f"sinks)",
+            sink,
+        ), sink
+
+    def _bound_from(self, call: ast.Call, mod) -> Set[str]:
+        """Names the statement containing ``call`` assigns — via the parent
+        links the engine's SourceModule provides (fallback: empty)."""
+        stmt = getattr(call, "_jg_stmt", None)
+        if stmt is None:
+            # resolve lazily: scan the module for the assignment whose value
+            # subtree contains this call
+            for n in ast.walk(mod.tree):
+                if isinstance(n, ast.Assign) and any(
+                        c is call for c in ast.walk(n.value)):
+                    stmt = n
+                    break
+            call._jg_stmt = stmt if stmt is not None else False
+        if not stmt:
+            return set()
+        return _common.assignment_targets(stmt)

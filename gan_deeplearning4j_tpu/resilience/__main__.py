@@ -47,6 +47,16 @@ def main(argv=None) -> int:
                    help="FaultSchedule JSON file (docs/RESILIENCE.md)")
     p.add_argument("--summary", default=None,
                    help="write the run summary JSON here as well as stdout")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable span tracing (also honored via "
+                        "GDT_TELEMETRY=trace); metrics are always on")
+    p.add_argument("--span-trace", default=None, metavar="PATH",
+                   help="dump the span tracer's Chrome trace JSON here on "
+                        "exit (implies --telemetry)")
+    p.add_argument("--trace-artifacts", default=None, metavar="DIR",
+                   help="SIGUSR2 captures a 1s jax.profiler device trace "
+                        "into this dir (default: $GDT_TRACE_DIR or "
+                        "./artifacts/device_traces)")
     args = p.parse_args(argv)
 
     from gan_deeplearning4j_tpu.harness import ExperimentConfig
@@ -57,6 +67,20 @@ def main(argv=None) -> int:
         SupervisorConfig,
         TrainingSupervisor,
     )
+
+    from gan_deeplearning4j_tpu.telemetry import device as _device
+    from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+    from gan_deeplearning4j_tpu.telemetry.trace import TRACER, configure_from_env
+
+    if args.telemetry or args.span_trace:
+        TRACER.enable()
+    else:
+        configure_from_env()
+    # SIGUSR2 → one bounded on-demand device capture; the supervisor's
+    # SIGTERM preemption handler is untouched (different signal, different
+    # contract)
+    _device.install_signal_capture(
+        args.trace_artifacts or _device.default_artifacts_dir())
 
     cfg = ExperimentConfig.from_json(args.config)
     with np.load(args.data) as npz:
@@ -82,11 +106,18 @@ def main(argv=None) -> int:
     sup.install_signal_handlers()
 
     def emit(summary: dict) -> None:
+        # one definition for bench artifacts and live metrics: the summary
+        # carries a registry snapshot, so the drill's BENCH record quotes
+        # the same series a scraper would
+        summary["telemetry"] = get_registry().snapshot()
         text = json.dumps(summary, indent=2, default=str)
         if args.summary:
             with open(args.summary, "w") as fh:
                 fh.write(text + "\n")
         print(text)
+        if args.span_trace:
+            TRACER.dump(args.span_trace,
+                        {"source": "gan_deeplearning4j_tpu.resilience"})
 
     try:
         summary = sup.run()

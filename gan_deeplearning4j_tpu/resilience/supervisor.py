@@ -43,6 +43,8 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from gan_deeplearning4j_tpu.resilience.store import CheckpointStore, tree_digest
+from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+from gan_deeplearning4j_tpu.telemetry.trace import TRACER
 
 logger = logging.getLogger(__name__)
 
@@ -127,6 +129,15 @@ class TrainingSupervisor:
         self._preempt = False
         self.retry_delays: List[float] = []
         self.events: List[dict] = []
+        # telemetry registry series (docs/OBSERVABILITY.md); the events
+        # list above remains the drill's per-run record
+        registry = get_registry()
+        self._c_steps = registry.counter(
+            "resilience_steps_total", "training steps completed")
+        self._c_restores = registry.counter(
+            "resilience_restores_total", "restores from a store generation")
+        self._c_faults = registry.counter(
+            "resilience_faults_total", "trapped worker faults (retried)")
 
     # -- preemption -----------------------------------------------------
     def request_preemption(self) -> None:
@@ -199,6 +210,11 @@ class TrainingSupervisor:
                 raise  # a config error retries into the same wall — terminal
             except Exception as exc:  # worker fault — retry from the store
                 attempt += 1
+                self._c_faults.inc()
+                TRACER.instant("resilience.fault", {
+                    "attempt": attempt,
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
                 self.events.append({
                     "event": "fault", "attempt": attempt,
                     "error": f"{type(exc).__name__}: {exc}",
@@ -234,7 +250,10 @@ class TrainingSupervisor:
             )
         generation = self.store.latest_valid()
         if generation is not None:
-            exp.load_models(directory=generation.path)
+            with TRACER.span("resilience.restore", gen=generation.number,
+                             attempt=attempt):
+                exp.load_models(directory=generation.path)
+            self._c_restores.inc()
             self.events.append({
                 "event": "restore", "generation": generation.number,
                 "step": exp.batch_counter, "attempt": attempt,
@@ -263,9 +282,18 @@ class TrainingSupervisor:
             last_publish_step = exp.batch_counter
             final_publish = info
 
+        t_segment = time.perf_counter()
+
+        def segment_span(status: str) -> None:
+            TRACER.complete(
+                "resilience.segment", t_segment, time.perf_counter(),
+                {"attempt": attempt, "start_step": start_step,
+                 "end_step": exp.batch_counter, "status": status})
+
         while exp.batch_counter < self.sup.total_steps:
             if self._preempt:
                 publish()
+                segment_span("preempted")
                 return self._summary(
                     "preempted", exp, attempt, start_step, restore_s,
                     first_step_s, train_s, publish_s, publish_count,
@@ -275,13 +303,20 @@ class TrainingSupervisor:
             feats, labels = self.batch_at(exp.batch_counter)
             t = time.perf_counter()
             exp.train_iteration(feats, labels)
-            train_s += time.perf_counter() - t
+            t_end = time.perf_counter()
+            train_s += t_end - t
+            if TRACER.enabled:  # don't build per-step args when off
+                TRACER.complete(
+                    "resilience.step", t, t_end,
+                    {"step": exp.batch_counter, "attempt": attempt})
+            self._c_steps.inc()
             if first_step_s is None:
                 first_step_s = time.perf_counter() - t0
             exp.batch_counter += 1
             if exp.batch_counter % self.sup.publish_every == 0:
                 publish()
         publish()  # final state, even off-cadence
+        segment_span("completed")
         return self._summary("completed", exp, attempt, start_step,
                              restore_s, first_step_s, train_s, publish_s,
                              publish_count, final_publish)

@@ -21,7 +21,10 @@ features) through one dynamic micro-batcher:
   backpressure (bounded queue that sheds with an explicit "overloaded"
   result instead of growing without bound);
 - :mod:`.service` — the in-process API plus a stdlib-only HTTP JSON
-  endpoint with ``/healthz`` and ``/metrics``;
+  endpoint with ``/healthz`` and ``/metrics`` (JSON or ``?format=prom``
+  Prometheus text), the served bundle's ``generation``, and the telemetry
+  debug hooks (``POST /debug/trace`` device captures, ``GET /debug/spans``
+  Chrome trace export — docs/OBSERVABILITY.md);
 - ``python -m gan_deeplearning4j_tpu.serving`` — the server CLI.
 
 Architecture notes: docs/SERVING.md.

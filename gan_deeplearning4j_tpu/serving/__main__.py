@@ -69,11 +69,25 @@ def main(argv=None) -> int:
                    help="persistent XLA compile cache dir so process "
                         "restarts reuse AOT artifacts (default: "
                         "$GDT_COMPILATION_CACHE / repo .jax_cache policy)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable span tracing (GET /debug/spans exports a "
+                        "Chrome trace; also honored via "
+                        "GDT_TELEMETRY=trace); metrics are always on")
+    p.add_argument("--debug-artifacts", default=None, metavar="DIR",
+                   help="where POST /debug/trace dumps jax.profiler device "
+                        "captures (default: $GDT_TRACE_DIR or "
+                        "./artifacts/device_traces)")
     args = p.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
+    from gan_deeplearning4j_tpu.telemetry.trace import TRACER, configure_from_env
+
+    if args.telemetry:
+        TRACER.enable()
+    else:
+        configure_from_env()
     from gan_deeplearning4j_tpu.runtime.environment import enable_compilation_cache
 
     cache_dir = enable_compilation_cache(args.compilation_cache)
@@ -102,6 +116,7 @@ def main(argv=None) -> int:
         default_timeout=args.timeout,
         warmup={"eager": "eager", "sync": "sync", "off": False}[args.warmup],
         pipeline_depth=args.pipeline_depth,
+        artifacts_dir=args.debug_artifacts,
     )
     serve_forever(service, args.host, args.port)
     return 0

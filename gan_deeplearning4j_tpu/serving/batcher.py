@@ -34,6 +34,15 @@ synchronous ``(kind, rows) -> rows`` callable (unit tests use fakes), or
 ``engine=`` for an object with the async ``dispatch(kind, rows_list)`` /
 ``finalize(handle)`` pair (``ServingEngine``, or a fake in the pipelining
 tests).
+
+Observability (docs/OBSERVABILITY.md): counters/gauges and THE latency
+histogram live in the process-wide telemetry registry (the per-instance
+ints remain for the instance-scoped ``metrics()`` JSON), and with tracing
+enabled every request leaves a correlated span chain — submit → cut →
+dispatch → flight(b/e) → finalize → scatter — whose trace id is carried
+on the request object across the worker/completer thread handoffs. With
+tracing disabled (the default) the hot path takes one ``TRACER.enabled``
+attribute read and allocates nothing.
 """
 
 from __future__ import annotations
@@ -46,10 +55,36 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
-from gan_deeplearning4j_tpu.utils.profiling import StageStats, percentiles
+from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+from gan_deeplearning4j_tpu.telemetry.trace import (
+    TRACER,
+    current_trace_id,
+    new_trace_id,
+)
+from gan_deeplearning4j_tpu.utils.profiling import StageStats
 
 #: pipeline stage names — the /metrics and serve_bench breakdown schema
 STAGES = ("assemble", "device", "complete")
+
+
+class _KindChildren:
+    """Per-kind registry series resolved once and cached in a plain dict —
+    the hot path does one dict lookup per update, never a labels() parse
+    (and never allocates a new series after the first request of a kind)."""
+
+    __slots__ = ("_family", "_fixed", "_cache")
+
+    def __init__(self, family, **fixed):
+        self._family = family
+        self._fixed = fixed
+        self._cache: Dict[str, object] = {}
+
+    def __call__(self, kind: str):
+        child = self._cache.get(kind)
+        if child is None:
+            child = self._family.labels(kind=kind, **self._fixed)
+            self._cache[kind] = child
+        return child
 
 
 @dataclasses.dataclass
@@ -82,6 +117,9 @@ class _Pending:
     enqueued: float
     event: threading.Event
     result: Optional[ServeResult] = None
+    # correlation id carried ACROSS the pipeline's threads explicitly (a
+    # contextvar would die at the worker handoff); None while tracing is off
+    trace_id: Optional[str] = None
 
     def finish(self, result: ServeResult) -> None:
         result.latency_s = time.monotonic() - self.enqueued
@@ -92,12 +130,13 @@ class _Pending:
 class _Inflight:
     """One dispatched flush traveling from worker to completer."""
 
-    __slots__ = ("riders", "handle", "total_rows")
+    __slots__ = ("riders", "handle", "total_rows", "flight_id")
 
-    def __init__(self, riders, handle, total_rows):
+    def __init__(self, riders, handle, total_rows, flight_id=None):
         self.riders = riders
         self.handle = handle
         self.total_rows = total_rows
+        self.flight_id = flight_id  # async-span id; None while tracing is off
 
 
 class MicroBatcher:
@@ -161,9 +200,34 @@ class MicroBatcher:
         self._errors = 0
         self._flushes = 0
         self._occupancy: Dict[int, int] = defaultdict(int)  # rows/flush -> n
-        self._latencies: Dict[str, deque] = defaultdict(
-            lambda: deque(maxlen=max_samples)
+        # -- telemetry registry series (docs/OBSERVABILITY.md catalogue).
+        # The ints above stay per-batcher (the JSON metrics() contract is
+        # instance-scoped); the registry series are the process-wide view a
+        # scraper reads. Latency SAMPLES live only in the registry
+        # histogram — the one stream metrics(), Prometheus, and serve_bench
+        # all quote (no separate client-side collection anywhere).
+        registry = get_registry()
+        requests_total = registry.counter(
+            "serve_requests_total", "request outcomes",
+            labelnames=("kind", "status"),
         )
+        self._c_request = {
+            status: _KindChildren(requests_total, status=status)
+            for status in ("ok", "overloaded", "deadline", "error")
+        }
+        self._c_latency = _KindChildren(registry.histogram(
+            "serve_request_latency_seconds",
+            "submit-to-result latency per request kind",
+            labelnames=("kind",), max_samples=max_samples,
+        ))
+        self._c_flushes = registry.counter(
+            "serve_flushes_total", "device flushes cut by the batcher")
+        self._c_flush_rows = registry.histogram(
+            "serve_flush_rows", "rows per flush (batch occupancy)",
+            max_samples=max_samples,
+        )
+        self._g_queue = registry.gauge(
+            "serve_queue_depth", "requests waiting in the batcher queue")
         self._stages = StageStats(STAGES, max_samples=max_samples)
 
         self._worker = threading.Thread(
@@ -195,17 +259,29 @@ class MicroBatcher:
             enqueued=now,
             event=threading.Event(),
         )
+        if TRACER.enabled:
+            # correlation id: reuse the caller's bound id (HTTP front end)
+            # or mint one; it rides the request object through both
+            # pipeline threads
+            req.trace_id = current_trace_id() or new_trace_id()
+            TRACER.instant("serve.batcher.submit", {
+                "kind": kind, "rows": int(rows.shape[0]),
+                "trace_id": req.trace_id,
+            })
         with self._lock:
             self._submitted[kind] += 1
             if self._closed:
                 self._shed_overloaded += 1
+                self._c_request["overloaded"](kind).inc()
                 return ServeResult("overloaded", error="batcher is closed")
             if len(self._queue) >= self.max_queue:
                 # backpressure: shed NOW, in O(1) — never queue what cannot
                 # be served, never block the client on a full queue
                 self._shed_overloaded += 1
+                self._c_request["overloaded"](kind).inc()
                 return ServeResult("overloaded", error="queue full")
             self._queue.append(req)
+            self._g_queue.set(len(self._queue))
             self._cv.notify_all()
         # the worker sheds expired requests, so this wait is bounded; the
         # grace covers flushes already in flight at deadline time — up to
@@ -221,9 +297,12 @@ class MicroBatcher:
             if not drain:
                 while self._queue:
                     self._shed_overloaded += 1  # keep the zero-lost ledger
-                    self._queue.popleft().finish(
+                    req = self._queue.popleft()
+                    self._c_request["overloaded"](req.kind).inc()
+                    req.finish(
                         ServeResult("overloaded", error="batcher is closed")
                     )
+                self._g_queue.set(0)
             self._cv.notify_all()
         self._worker.join(timeout=10.0)
         self._completer.join(timeout=10.0)
@@ -292,6 +371,7 @@ class MicroBatcher:
                 # Skipping it for younger fitting riders would starve it
                 # forever under sustained same-kind traffic.
                 self._queue.popleft()
+                self._g_queue.set(len(self._queue))
                 self._window_used += 1
                 return [oldest]
             batch, keep, total = [], deque(), 0
@@ -310,6 +390,7 @@ class MicroBatcher:
                 batch.append(target)
                 keep = deque(r for r in self._queue if r is not target)
             self._queue = keep
+            self._g_queue.set(len(self._queue))
             self._window_used += 1
             return batch
 
@@ -324,12 +405,14 @@ class MicroBatcher:
         for req in self._queue:
             if now > req.deadline:
                 self._shed_deadline += 1
+                self._c_request["deadline"](req.kind).inc()
                 req.finish(
                     ServeResult("deadline", error="expired while queued")
                 )
             else:
                 keep.append(req)
         self._queue = keep
+        self._g_queue.set(len(self._queue))
         return True
 
     def _release_slot(self) -> None:
@@ -368,6 +451,7 @@ class MicroBatcher:
                     if now > req.deadline:
                         with self._lock:
                             self._shed_deadline += 1
+                        self._c_request["deadline"](req.kind).inc()
                         req.finish(
                             ServeResult("deadline", error="expired while queued")
                         )
@@ -376,6 +460,13 @@ class MicroBatcher:
                 if not live:
                     self._release_slot()
                     continue
+                flight_id = None
+                if TRACER.enabled:
+                    flight_id = new_trace_id()
+                    TRACER.instant("serve.batcher.cut", {
+                        "kind": live[0].kind, "flight": flight_id,
+                        "riders": [r.trace_id for r in live],
+                    })
                 t0 = time.perf_counter()
                 try:
                     handle = self._dispatch(
@@ -385,14 +476,24 @@ class MicroBatcher:
                     with self._lock:
                         self._errors += len(live)
                     for req in live:
+                        self._c_request["error"](req.kind).inc()
                         req.finish(ServeResult(
                             "error", error=f"{type(exc).__name__}: {exc}"))
                     self._release_slot()
                     continue
                 total = sum(r.rows.shape[0] for r in live)
+                if flight_id is not None:
+                    TRACER.complete(
+                        "serve.batcher.dispatch", t0, time.perf_counter(),
+                        {"kind": live[0].kind, "flight": flight_id,
+                         "rows": total,
+                         "riders": [r.trace_id for r in live]})
+                    TRACER.async_begin("serve.flight", flight_id,
+                                       {"kind": live[0].kind, "rows": total})
                 with self._lock:
                     self._stages.add("assemble", time.perf_counter() - t0)
-                    self._inflight.append(_Inflight(live, handle, total))
+                    self._inflight.append(
+                        _Inflight(live, handle, total, flight_id))
                     self._cv.notify_all()
         finally:
             with self._lock:
@@ -411,9 +512,13 @@ class MicroBatcher:
             try:
                 out = self._finalize(ent.handle)
             except Exception as exc:  # engine failure -> every rider errors
+                if ent.flight_id is not None:
+                    TRACER.async_end("serve.flight", ent.flight_id,
+                                     {"status": "error"})
                 with self._lock:
                     self._errors += len(ent.riders)
                 for req in ent.riders:
+                    self._c_request["error"](req.kind).inc()
                     req.finish(ServeResult(
                         "error", error=f"{type(exc).__name__}: {exc}"))
                 self._release_slot()
@@ -425,14 +530,27 @@ class MicroBatcher:
                 req.finish(ServeResult("ok", data=out[offset:offset + n]))
                 offset += n
             t2 = time.perf_counter()
+            if ent.flight_id is not None:
+                kind = ent.riders[0].kind
+                TRACER.complete("serve.batcher.finalize", t0, t1,
+                                {"kind": kind, "flight": ent.flight_id})
+                TRACER.complete(
+                    "serve.batcher.scatter", t1, t2,
+                    {"kind": kind, "flight": ent.flight_id,
+                     "riders": [r.trace_id for r in ent.riders]})
+                TRACER.async_end("serve.flight", ent.flight_id,
+                                 {"status": "ok"})
             with self._lock:
                 self._stages.add("device", t1 - t0)
                 self._stages.add("complete", t2 - t1)
                 self._flushes += 1
+                self._c_flushes.inc()
                 self._occupancy[ent.total_rows] += 1
+                self._c_flush_rows.observe(ent.total_rows)
                 for req in ent.riders:
                     self._completed[req.kind] += 1
-                    self._latencies[req.kind].append(req.result.latency_s)
+                    self._c_request["ok"](req.kind).inc()
+                    self._c_latency(req.kind).observe(req.result.latency_s)
             self._release_slot()
 
     # -- observability ------------------------------------------------------
@@ -440,13 +558,19 @@ class MicroBatcher:
         """Counter snapshot + latency percentiles + occupancy histogram +
         per-stage pipeline breakdown — the /metrics payload schema
         (docs/SERVING.md)."""
-        with self._lock:
-            lat = {
-                kind: {
-                    k: v * 1e3 for k, v in percentiles(samples).items()
-                }
-                for kind, samples in self._latencies.items()
+        # latency percentiles come from the ONE registry histogram stream
+        # (serve_request_latency_seconds) — the same numbers a Prometheus
+        # scrape and a serve_bench artifact quote. list() snapshots the
+        # child cache in one GIL-atomic step: the pipeline threads insert a
+        # kind's child concurrently with a scrape, and iterating the live
+        # dict would raise mid-resize
+        lat = {
+            kind: {
+                k: v * 1e3 for k, v in child.percentiles().items()
             }
+            for kind, child in list(self._c_latency._cache.items())
+        }
+        with self._lock:
             return {
                 "submitted": dict(self._submitted),
                 "completed": dict(self._completed),

@@ -54,6 +54,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 #: staging buffers kept per (kind, bucket) — enough for a deep pipeline
@@ -112,11 +115,16 @@ class ServingEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         feature_vertex: Optional[str] = None,
         replicas: Optional[int] = 1,
+        generation: Optional[int] = None,
     ):
         import jax
 
         if not models:
             raise ValueError("ServingEngine needs at least one model")
+        #: store generation of the loaded bundle (None for bare-checkpoint
+        #: loads) — the version the reload plane keys on; /healthz and
+        #: /metrics surface it so an operator can see WHICH model serves
+        self.generation = generation
         buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"invalid bucket ladder {buckets!r}")
@@ -178,6 +186,38 @@ class ServingEngine:
         self._batch_sharding = None
         self._compile_counts: Dict[str, int] = {k: 0 for k in self._kinds}
         self._serve_compiles: Dict[str, int] = {k: 0 for k in self._kinds}
+        # telemetry registry mirrors of the compile ledger + routing
+        # (docs/OBSERVABILITY.md): the dict above stays the per-engine
+        # invariant the bench asserts; the registry series are what a
+        # scraper and the BENCH snapshot read
+        _registry = get_registry()
+        _compiles = _registry.counter(
+            "serve_engine_compiles_total",
+            "XLA compiles per request kind (warmup + serve-time)",
+            labelnames=("kind",),
+        )
+        _serve_c = _registry.counter(
+            "serve_engine_serve_compiles_total",
+            "post-warmup compiles per kind (fast-path contract: stays 0)",
+            labelnames=("kind",),
+        )
+        self._c_compiles = {k: _compiles.labels(kind=k) for k in self._kinds}
+        self._c_serve_compiles = {
+            k: _serve_c.labels(kind=k) for k in self._kinds
+        }
+        _dispatches = _registry.counter(
+            "serve_engine_dispatches_total",
+            "flush dispatches routed per replica",
+            labelnames=("replica",),
+        )
+        self._c_dispatches = [
+            _dispatches.labels(replica=str(i)) for i in range(replicas)
+        ]
+        self._g_generation = _registry.gauge(
+            "serving_generation",
+            "store generation of the served bundle (-1 = unversioned)",
+        )
+        self._g_generation.set(-1 if generation is None else generation)
         self._staging: Dict[Tuple[str, int], List[_StagingBuf]] = {}
         self._outstanding = [0] * replicas  # in-flight flushes per replica
         self._dispatches = [0] * replicas
@@ -201,19 +241,22 @@ class ServingEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         feature_vertex: Optional[str] = None,
         replicas: Optional[int] = 1,
+        generation: Optional[int] = None,
     ) -> "ServingEngine":
         """Restore from serializer checkpoint zips. Updater state is never
         loaded — a serving replica has no optimizer."""
         from gan_deeplearning4j_tpu.utils.serializer import read_model
 
         models = {}
-        for role, path in (("generator", generator), ("classifier", classifier)):
-            if path is None:
-                continue
-            graph, params, _, _ = read_model(path, load_updater=False)
-            models[role] = (graph, params)
+        with TRACER.span("serve.engine.restore", generation=generation):
+            for role, path in (("generator", generator),
+                               ("classifier", classifier)):
+                if path is None:
+                    continue
+                graph, params, _, _ = read_model(path, load_updater=False)
+                models[role] = (graph, params)
         return cls(models, buckets=buckets, feature_vertex=feature_vertex,
-                   replicas=replicas)
+                   replicas=replicas, generation=generation)
 
     @classmethod
     def from_bundle(
@@ -240,6 +283,7 @@ class ServingEngine:
             buckets=buckets,
             feature_vertex=manifest.get("feature_vertex"),
             replicas=replicas,
+            generation=manifest.get("generation"),
         )
 
     # -- introspection ------------------------------------------------------
@@ -312,6 +356,7 @@ class ServingEngine:
                 per_replica[r] += 1
             return {
                 "replicas": len(self._devices),
+                "generation": self.generation,
                 "replica_dispatches": list(self._dispatches),
                 "replica_in_flight": list(self._outstanding),
                 "compile_counts": dict(self._compile_counts),
@@ -353,16 +398,20 @@ class ServingEngine:
             # AOT: lower for the exact padded shape on the exact replica
             # device and keep the executable; serve-time calls can then
             # never re-trace or re-compile
-            exe = jax.jit(fn).lower(
-                self._params[role][replica], spec
-            ).compile()
+            with TRACER.span("serve.engine.compile", kind=kind,
+                             bucket=bucket, replica=replica):
+                exe = jax.jit(fn).lower(
+                    self._params[role][replica], spec
+                ).compile()
             with self._lock:
                 self._compiled[key] = exe
                 self._compile_counts[kind] += 1
+                self._c_compiles[kind].inc()
                 # a compile after warmup finished — OR after it failed —
                 # is a serve-time compile: some request is paying for it
                 if self._warmed or self._warm_error is not None:
                     self._serve_compiles[kind] += 1
+                    self._c_serve_compiles[kind].inc()
             return exe
 
     def _bulk_executable(self, kind: str):
@@ -395,12 +444,18 @@ class ServingEngine:
             spec = jax.ShapeDtypeStruct(
                 (slab, self._in_width[kind]), np.float32, sharding=batched
             )
-            exe = jax.jit(fn).lower(self._params_mesh[role], spec).compile()
+            with TRACER.span("serve.engine.compile", kind=kind,
+                             bucket=slab, replica="bulk"):
+                exe = jax.jit(fn).lower(
+                    self._params_mesh[role], spec
+                ).compile()
             with self._lock:
                 self._bulk[kind] = exe
                 self._compile_counts[kind] += 1
+                self._c_compiles[kind].inc()
                 if self._warmed or self._warm_error is not None:
                     self._serve_compiles[kind] += 1
+                    self._c_serve_compiles[kind].inc()
             return exe
 
     def warmup(self, background: bool = False):
@@ -481,7 +536,8 @@ class ServingEngine:
             self._rr += 1
             self._outstanding[r] += 1
             self._dispatches[r] += 1
-            return r
+        self._c_dispatches[r].inc()
+        return r
 
     # -- execution ----------------------------------------------------------
     def _validate(self, kind: str, rows_list) -> int:

@@ -8,9 +8,16 @@ framework dependency, per the repo's no-new-deps rule). Endpoints:
 - ``POST /v1/sample``    {"data": [[z...], ...]}  -> {"status","data"}
 - ``POST /v1/classify``  {"data": [[x...], ...]}  -> {"status","data"}
 - ``POST /v1/features``  {"data": [[x...], ...]}  -> {"status","data"}
-- ``GET  /healthz``      liveness + loaded kinds
+- ``GET  /healthz``      liveness + loaded kinds + served bundle generation
 - ``GET  /metrics``      request counters, p50/p95/p99 latency, batch-
-  occupancy histogram, shed counts, per-kind compile counts
+  occupancy histogram, shed counts, per-kind compile counts, generation;
+  ``?format=prom`` switches to Prometheus text exposition straight off the
+  process-wide telemetry registry (docs/OBSERVABILITY.md)
+- ``POST /debug/trace?ms=N``  on-demand ``jax.profiler`` device capture
+  into the service's artifacts dir — 202 + the artifact path (async;
+  ``block=1`` waits for 200), 409 while one is running
+- ``GET  /debug/spans``  the span tracer's Chrome trace JSON (Perfetto-
+  loadable; empty unless tracing is enabled)
 
 Shed responses map to HTTP 503 (overloaded / deadline) so load balancers
 can react; engine errors map to 500, bad requests to 400.
@@ -22,11 +29,19 @@ import json
 import logging
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
+from urllib.parse import parse_qs
 
 import numpy as np
 
 from gan_deeplearning4j_tpu.serving.batcher import MicroBatcher, ServeResult
 from gan_deeplearning4j_tpu.serving.engine import ServingEngine
+from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+from gan_deeplearning4j_tpu.telemetry.trace import (
+    TRACER,
+    bind_trace_id,
+    new_trace_id,
+    unbind_trace_id,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -55,8 +70,12 @@ class InferenceService:
         default_timeout: float = 5.0,
         warmup="sync",
         pipeline_depth: Optional[int] = None,
+        artifacts_dir: Optional[str] = None,
     ):
         self.engine = engine
+        # where POST /debug/trace dumps device captures (resolved lazily so
+        # constructing a service never touches the filesystem)
+        self.artifacts_dir = artifacts_dir
         if warmup in (True, "sync"):
             engine.warmup()
         elif warmup in ("eager", "background"):
@@ -97,26 +116,77 @@ class InferenceService:
             "kinds": list(self.engine.kinds),
             "buckets": list(self.engine.buckets),
             "replicas": self.engine.replica_count,
+            # the version the reload plane (and any canary gate) keys on:
+            # None when the engine was loaded from bare checkpoints
+            "generation": self.engine.generation,
         }
         if status == "error":
             body["error"] = "engine warmup failed"
         return body
 
     def metrics(self) -> dict:
+        """The JSON ``/metrics`` payload — the PR 3 schema plus
+        ``generation`` (a schema-compatible superset; every number now
+        originates in the telemetry registry or the batcher ledger)."""
         return {
             **self.batcher.metrics(),
+            "generation": self.engine.generation,
             "engine": self.engine.stats(),
             "compile_counts": self.engine.compile_counts,
         }
 
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the process-wide registry —
+        ``GET /metrics?format=prom``."""
+        return get_registry().to_prometheus()
+
+    def _debug_trace(self, params: dict) -> Tuple[int, dict]:
+        """POST /debug/trace?ms=N — one bounded device capture, dumped
+        under the artifacts dir. Asynchronous by default (202 + the path
+        the artifact will land at): profiler start/stop costs tens of
+        seconds on a cold profiler, far past any sane client timeout, and
+        the capture wants to see live traffic anyway. ``block=1`` waits
+        and answers 200 once the artifact is on disk."""
+        from gan_deeplearning4j_tpu.telemetry import device as _device
+
+        try:
+            ms = int(params.get("ms", ["1000"])[0])
+            if ms < 1 or ms > 60_000:
+                raise ValueError(ms)
+        except (TypeError, ValueError):
+            return 400, {"status": "error",
+                         "error": f"bad 'ms': {params.get('ms')!r} "
+                                  f"(want 1..60000)"}
+        block = params.get("block", ["0"])[0] not in ("0", "", "false")
+        artifacts = self.artifacts_dir or _device.default_artifacts_dir()
+        try:
+            if block:
+                path = _device.capture_device_trace(artifacts, duration_ms=ms)
+                return 200, {"status": "ok", "artifact": path,
+                             "duration_ms": ms}
+            _, path = _device.capture_async(artifacts, duration_ms=ms)
+        except _device.CaptureBusy as exc:
+            return 409, {"status": "error", "error": str(exc)}
+        return 202, {"status": "accepted", "artifact": path,
+                     "duration_ms": ms}
+
     def handle(self, method: str, path: str, payload: Optional[dict] = None
                ) -> Tuple[int, dict]:
         """(http_status, response_body) for one request — the single routing
-        table both front ends use."""
+        table both front ends use. (``/metrics?format=prom`` is the one
+        route with a non-JSON body; the HTTP front end serves it from
+        :meth:`metrics_text` before reaching this table.)"""
+        path, _, query = path.partition("?")
+        params = parse_qs(query) if query else {}
         if method == "GET" and path == "/healthz":
             return 200, self.healthz()
         if method == "GET" and path == "/metrics":
             return 200, self.metrics()
+        if method == "GET" and path == "/debug/spans":
+            return 200, TRACER.chrome_trace(
+                {"source": "gan_deeplearning4j_tpu.serving"})
+        if method == "POST" and path == "/debug/trace":
+            return self._debug_trace(params)
         if method == "POST" and path.startswith("/v1/"):
             kind = path[len("/v1/"):]
             if kind not in self.engine.kinds:
@@ -147,7 +217,20 @@ class InferenceService:
                 except (TypeError, ValueError):
                     return 400, {"status": "error",
                                  "error": f"bad 'timeout': {timeout!r}"}
-            result = self.batcher.submit(kind, rows, timeout=timeout)
+            if TRACER.enabled:
+                # one correlation id per request: the batcher's submit
+                # picks it off the contextvar and carries it across the
+                # pipeline's threads
+                token = bind_trace_id(new_trace_id())
+                try:
+                    with TRACER.span("serve.request", kind=kind,
+                                     rows=int(rows.shape[0])):
+                        result = self.batcher.submit(
+                            kind, rows, timeout=timeout)
+                finally:
+                    unbind_trace_id(token)
+            else:
+                result = self.batcher.submit(kind, rows, timeout=timeout)
             body = {"status": result.status,
                     "latency_ms": result.latency_s * 1e3}
             if result.ok:
@@ -176,6 +259,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 (http.server naming contract)
         try:
+            route, _, query = self.path.partition("?")
+            if (route == "/metrics"
+                    and "prom" in parse_qs(query).get("format", [])):
+                # the one non-JSON body: Prometheus text exposition
+                data = self.service.metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             status, body = self.service.handle("GET", self.path)
         except Exception as exc:  # a handler bug must answer 500, not reset
             logger.exception("GET %s failed", self.path)

@@ -1,0 +1,74 @@
+"""telemetry/ — the unified observability plane (docs/OBSERVABILITY.md).
+
+One substrate, three parts, consumed by every other plane (training
+harness, serving, resilience, the bench scripts):
+
+- :mod:`.trace` — structured spans in a bounded ring buffer with Chrome
+  trace-event export (Perfetto-loadable) and correlation ids that survive
+  the batcher's cross-thread pipeline and supervisor attempts. Disabled
+  by default at zero hot-path cost.
+- :mod:`.registry` — process-wide named counters/gauges/histograms with
+  labeled series; exported as the JSON ``/metrics`` payload, Prometheus
+  text exposition, and BENCH artifact snapshots from the same storage.
+- :mod:`.device` — on-demand ``jax.profiler`` captures behind the serving
+  API's ``POST /debug/trace`` and the supervisor CLI's SIGUSR2.
+
+The package namespace is LAZY (PEP 562) like the project root: importing
+it must not import jax — ``registry``/``trace`` are stdlib-only and the
+analyzer and bench parent depend on that; only :mod:`.device` touches jax,
+and only inside a capture.
+"""
+
+# name -> (module to import, attribute to take from it; None = the module)
+_LAZY_EXPORTS = {
+    "MetricsRegistry": ("gan_deeplearning4j_tpu.telemetry.registry",
+                        "MetricsRegistry"),
+    "get_registry": ("gan_deeplearning4j_tpu.telemetry.registry",
+                     "get_registry"),
+    "set_registry": ("gan_deeplearning4j_tpu.telemetry.registry",
+                     "set_registry"),
+    "percentiles": ("gan_deeplearning4j_tpu.telemetry.registry",
+                    "percentiles"),
+    "Tracer": ("gan_deeplearning4j_tpu.telemetry.trace", "Tracer"),
+    "TRACER": ("gan_deeplearning4j_tpu.telemetry.trace", "TRACER"),
+    "get_tracer": ("gan_deeplearning4j_tpu.telemetry.trace", "get_tracer"),
+    "new_trace_id": ("gan_deeplearning4j_tpu.telemetry.trace",
+                     "new_trace_id"),
+    "current_trace_id": ("gan_deeplearning4j_tpu.telemetry.trace",
+                         "current_trace_id"),
+    "bind_trace_id": ("gan_deeplearning4j_tpu.telemetry.trace",
+                      "bind_trace_id"),
+    "unbind_trace_id": ("gan_deeplearning4j_tpu.telemetry.trace",
+                        "unbind_trace_id"),
+    "configure_from_env": ("gan_deeplearning4j_tpu.telemetry.trace",
+                           "configure_from_env"),
+    "capture_device_trace": ("gan_deeplearning4j_tpu.telemetry.device",
+                             "capture_device_trace"),
+    "capture_async": ("gan_deeplearning4j_tpu.telemetry.device",
+                      "capture_async"),
+    "install_signal_capture": ("gan_deeplearning4j_tpu.telemetry.device",
+                               "install_signal_capture"),
+    "CaptureBusy": ("gan_deeplearning4j_tpu.telemetry.device",
+                    "CaptureBusy"),
+}
+
+__all__ = list(_LAZY_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    module = importlib.import_module(module_name)
+    value = module if attr is None else getattr(module, attr)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted({*globals(), *_LAZY_EXPORTS})

@@ -1,0 +1,156 @@
+"""On-demand device profiling — ``jax.profiler`` captures behind a hook.
+
+The span tracer (:mod:`.trace`) answers *which stage* took the time; the
+device profiler answers *what the XLA timeline did inside it* — per-op
+device occupancy, HBM traffic, compile stalls. A capture is expensive
+(tens of MB, device interference), so it is never ambient: a live process
+exposes it as a momentary hook —
+
+- the serving API's ``POST /debug/trace?ms=N`` (service.py), and
+- ``SIGUSR2`` on the supervisor worker CLI (resilience/__main__.py) —
+
+each dumping one bounded capture into an artifacts directory and
+returning to normal operation. Dumps are TensorBoard-profile format
+(``.xplane.pb`` under ``plugins/profile/``; newer jax wheels also emit a
+Perfetto trace when asked). ``jax`` is imported lazily so this module —
+and the telemetry package with it — stays importable in jax-free
+containers.
+
+One capture at a time per process: ``jax.profiler`` rejects nested
+captures, so the hook refuses (``CaptureBusy``) instead of crashing the
+serving thread that raced a second request in.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_capture_lock = threading.Lock()
+_capture_ids = itertools.count(1)
+
+
+class CaptureBusy(RuntimeError):
+    """A device capture is already running in this process."""
+
+
+def _capture_dir(artifacts_dir: str) -> str:
+    # the counter keeps two captures started within the same wall-clock
+    # second from landing (and overwriting) in one directory
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    return os.path.join(
+        artifacts_dir,
+        f"device-{stamp}-pid{os.getpid()}-{next(_capture_ids)}",
+    )
+
+
+def _capture_locked(out: str, duration_ms: int) -> str:
+    """The capture itself. The CALLER holds ``_capture_lock``."""
+    import jax
+
+    os.makedirs(out, exist_ok=True)
+    try:
+        # newer wheels can emit a Perfetto trace next to the xplane dump
+        jax.profiler.start_trace(out, create_perfetto_trace=True)
+    except TypeError:  # older start_trace signature
+        jax.profiler.start_trace(out)
+    try:
+        time.sleep(duration_ms / 1000.0)
+    finally:
+        jax.profiler.stop_trace()
+    logger.info("device trace captured to %s (%d ms)", out, duration_ms)
+    return out
+
+
+def capture_device_trace(artifacts_dir: str, duration_ms: int = 1000,
+                         out: Optional[str] = None) -> str:
+    """Capture ``duration_ms`` of device activity into ``out`` (default: a
+    fresh stamped directory under ``artifacts_dir``); returns that
+    directory. Blocks the calling thread for the capture window PLUS
+    profiler start/stop cost — tens of seconds on a cold profiler under a
+    sandboxed kernel — so interactive callers use :func:`capture_async`
+    (the serving hook answers 202 with the artifact path immediately)."""
+    if duration_ms < 1:
+        raise ValueError("duration_ms must be >= 1")
+    out = out or _capture_dir(artifacts_dir)
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a device capture is already in progress")
+    try:
+        return _capture_locked(out, duration_ms)
+    finally:
+        _capture_lock.release()
+
+
+def install_signal_capture(artifacts_dir: str,
+                           duration_ms: int = 1000,
+                           signum: int = signal.SIGUSR2) -> None:
+    """SIGUSR2 → one background device capture. The handler only spawns a
+    daemon thread (signal handlers must not block for the capture window);
+    a signal landing mid-capture is logged and dropped — a stuck operator
+    mashing SIGUSR2 must not stack captures."""
+
+    def _worker() -> None:
+        try:
+            capture_device_trace(artifacts_dir, duration_ms)
+        except CaptureBusy:
+            logger.warning("SIGUSR2 ignored: a device capture is running")
+        except Exception:
+            logger.exception("SIGUSR2 device capture failed")
+
+    def _handler(signo, frame) -> None:
+        threading.Thread(
+            target=_worker, name="device-trace-capture", daemon=True
+        ).start()
+
+    signal.signal(signum, _handler)
+    logger.info("signal %d captures %d ms device traces into %s",
+                signum, duration_ms, artifacts_dir)
+
+
+def capture_async(artifacts_dir: str, duration_ms: int = 1000
+                  ) -> Tuple["threading.Thread", str]:
+    """Start a capture on a daemon thread; returns ``(thread, out_dir)``
+    so the caller can answer immediately with the path the artifact WILL
+    land at (the serving hook's 202 contract). The lock is ACQUIRED here,
+    before returning — two racing callers cannot both get a 202 whose
+    artifact then silently never lands; the loser gets
+    :class:`CaptureBusy` synchronously and the caller can 409. The spawned
+    thread inherits lock ownership and releases it when the capture (or
+    its failure) finishes."""
+    if duration_ms < 1:
+        raise ValueError("duration_ms must be >= 1")
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a device capture is already in progress")
+    out = _capture_dir(artifacts_dir)
+    t = threading.Thread(
+        target=_swallow_owned, args=(out, duration_ms),
+        name="device-trace-capture", daemon=True,
+    )
+    t.start()
+    return t, out
+
+
+def _swallow_owned(out: str, duration_ms: int) -> None:
+    """Async capture body: lock already held by capture_async."""
+    try:
+        _capture_locked(out, duration_ms)
+    except Exception:
+        logger.exception("device capture failed")
+    finally:
+        _capture_lock.release()
+
+
+def default_artifacts_dir(base: Optional[str] = None) -> str:
+    """Where hook-triggered captures land unless configured:
+    ``$GDT_TRACE_DIR``, else ``<base or cwd>/artifacts/device_traces``."""
+    env = os.environ.get("GDT_TRACE_DIR")
+    if env:
+        return env
+    return os.path.join(base or os.getcwd(), "artifacts", "device_traces")

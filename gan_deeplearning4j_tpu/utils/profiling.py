@@ -5,6 +5,16 @@ log lines ("Training discriminator!" etc., dl4jGANComputerVision.java:424,
 469,515). Here each phase of the training loop runs inside a timing scope,
 and ``device_trace`` wraps ``jax.profiler.trace`` for TensorBoard/Perfetto
 captures of the XLA timeline when deeper inspection is needed.
+
+Since the telemetry plane landed (docs/OBSERVABILITY.md), both timers here
+are REGISTRY-BACKED: the per-phase/per-stage sample streams live in
+histograms of the process-wide :mod:`gan_deeplearning4j_tpu.telemetry`
+registry (``train_phase_seconds{phase=...}``,
+``serve_stage_seconds{stage=...}``), so ``/metrics``, Prometheus scrapes,
+BENCH artifacts, and these objects' own ``report()``/``summary_ms()`` all
+read the same samples. The Python API is unchanged — ``totals``/``counts``
+(PhaseTimer) and ``busy``/``occupancy()`` (StageStats) stay per-instance,
+which is what their callers aggregate over one run.
 """
 
 from __future__ import annotations
@@ -12,28 +22,20 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from collections import defaultdict, deque
-from typing import Dict, Iterable, Iterator, Optional, Sequence
+from collections import defaultdict
+from typing import Dict, Iterator, Optional, Sequence
 
 import jax
 
+from gan_deeplearning4j_tpu.telemetry.registry import (
+    get_registry,
+    percentiles,
+)
+from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
 logger = logging.getLogger(__name__)
 
-
-def percentiles(values: Iterable[float], qs: Sequence[float] = (50, 95, 99)) -> Dict[str, float]:
-    """Nearest-rank percentiles of ``values`` as ``{"p50": ..., ...}``
-    (empty dict for no samples). Shared by PhaseTimer.report and the serving
-    latency metrics — one definition so BENCH artifacts and /metrics agree."""
-    import math
-
-    data = sorted(float(v) for v in values)
-    if not data:
-        return {}
-    out = {}
-    for q in qs:
-        rank = max(1, min(len(data), math.ceil(q / 100.0 * len(data))))
-        out[f"p{q:g}"] = data[rank - 1]
-    return out
+__all__ = ["percentiles", "PhaseTimer", "StageStats", "device_trace"]
 
 
 class PhaseTimer:
@@ -42,14 +44,29 @@ class PhaseTimer:
     Keeps the most recent ``max_samples`` per-call durations per phase so
     ``report()``/``percentile()`` can state tail latency (p50/p95/p99), not
     just the mean — a mean hides exactly the stalls (recompiles, host syncs)
-    worth finding."""
+    worth finding. The samples live in the telemetry registry histogram
+    ``train_phase_seconds`` (one stream for this object, ``/metrics``, and
+    BENCH artifacts); ``totals``/``counts`` stay per-instance."""
 
-    def __init__(self, max_samples: int = 65536):
+    def __init__(self, max_samples: int = 65536,
+                 metric: str = "train_phase_seconds", registry=None):
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
-        self.samples: Dict[str, deque] = defaultdict(
-            lambda: deque(maxlen=max_samples)
+        self._hist = (registry or get_registry()).histogram(
+            metric, "wall seconds per named training phase",
+            labelnames=("phase",), max_samples=max_samples,
         )
+        # per-phase series resolved once and cached (the labels() parse is
+        # not for the per-iteration path), mirroring the batcher's idiom
+        self._children: Dict[str, object] = {}
+        self.samples: Dict = _SampleView(self._children)
+
+    def _child(self, name: str):
+        child = self._children.get(name)
+        if child is None:
+            child = self._hist.labels(phase=name)
+            self._children[name] = child
+        return child
 
     @contextlib.contextmanager
     def phase(self, name: str) -> Iterator[list]:
@@ -65,29 +82,64 @@ class PhaseTimer:
         finally:
             if sink:
                 jax.block_until_ready(sink)
-            elapsed = time.perf_counter() - start
+            end = time.perf_counter()
+            elapsed = end - start
             self.totals[name] += elapsed
             self.counts[name] += 1
-            self.samples[name].append(elapsed)
+            self._child(name).observe(elapsed)
+            if TRACER.enabled:  # the harness's phases double as trace
+                # spans, so a training trace and a serving trace fold
+                # with the same tooling (guarded: no per-phase f-string
+                # when tracing is off)
+                TRACER.complete(f"train.{name}", start, end)
 
     def mean(self, name: str) -> float:
         c = self.counts.get(name, 0)
         return self.totals[name] / c if c else 0.0
 
+    def _percentiles(self, name: str, qs=(50, 95, 99)) -> Dict[str, float]:
+        # through Histogram.percentiles (copies under the series lock):
+        # another thread may be observing into the same process-wide
+        # series while this reads
+        child = self._children.get(name)
+        return child.percentiles(qs) if child is not None else {}
+
     def percentile(self, name: str, q: float) -> float:
-        return percentiles(self.samples.get(name, ()), (q,)).get(f"p{q:g}", 0.0)
+        return self._percentiles(name, (q,)).get(f"p{q:g}", 0.0)
 
     def report(self) -> str:
         rows = sorted(self.totals.items(), key=lambda kv: -kv[1])
         out = []
         for name, total in rows:
-            ps = percentiles(self.samples.get(name, ()))
+            ps = self._percentiles(name)
             tail = "  ".join(f"{k} {v*1e3:8.2f}ms" for k, v in ps.items())
             out.append(
                 f"{name:>24s}: total {total:8.3f}s  mean {self.mean(name)*1e3:8.2f}ms  "
                 f"{tail}  n={self.counts[name]}"
             )
         return "\n".join(out)
+
+
+class _SampleView:
+    """Dict-like read view over the timer's per-phase sample deques, so
+    ``timer.samples[name]`` keeps working while the storage lives in the
+    registry (the single-sample-stream contract). Read-only: probing a
+    name that was never timed returns empty instead of materializing a
+    phantom count-0 series in /metrics."""
+
+    def __init__(self, children: Dict[str, object]):
+        self._children = children
+
+    def __getitem__(self, name: str):
+        child = self._children.get(name)
+        return child.samples if child is not None else ()
+
+    def get(self, name: str, default=()):
+        child = self._children.get(name)
+        return child.samples if child is not None else default
+
+    def keys(self):
+        return list(self._children)
 
 
 class StageStats:
@@ -99,36 +151,49 @@ class StageStats:
     ``occupancy()`` is busy-seconds / wall-seconds since construction —
     the direct read on whether the pipeline overlaps (assemble occupancy
     ≪ 1 while device occupancy ≈ 1 means the host keeps the device fed).
-    Not synchronized: callers serialize ``add`` per stage (the batcher
-    records each stage from the one thread that runs it)."""
+    Per-stage samples live in the registry histogram
+    ``serve_stage_seconds`` (its ``sum`` is the process-wide busy time);
+    ``busy`` and the wall-clock origin stay per-instance, and callers
+    serialize ``add`` per stage (the batcher records each stage from the
+    one thread that runs it)."""
 
-    def __init__(self, stages: Sequence[str], max_samples: int = 65536):
+    def __init__(self, stages: Sequence[str], max_samples: int = 65536,
+                 metric: str = "serve_stage_seconds", registry=None):
         self._t0 = time.monotonic()
         self.busy: Dict[str, float] = {s: 0.0 for s in stages}
-        self.samples: Dict[str, deque] = {
-            s: deque(maxlen=max_samples) for s in stages
-        }
+        hist = (registry or get_registry()).histogram(
+            metric, "busy seconds per pipeline stage, per flush",
+            labelnames=("stage",), max_samples=max_samples,
+        )
+        self._children = {s: hist.labels(stage=s) for s in stages}
+        self.samples: Dict = {s: c.samples for s, c in self._children.items()}
 
     def add(self, stage: str, seconds: float) -> None:
         self.busy[stage] += seconds
-        self.samples[stage].append(seconds)
+        self._children[stage].observe(seconds)
 
     def occupancy(self) -> Dict[str, float]:
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         return {s: b / elapsed for s, b in self.busy.items()}
 
     def summary_ms(self) -> Dict[str, Dict[str, float]]:
+        # read through Histogram.percentiles (copies under the series lock):
+        # the worker/completer threads observe concurrently with a /metrics
+        # read, and iterating a deque mid-append raises
         return {
-            s: {k: v * 1e3 for k, v in percentiles(samples).items()}
-            for s, samples in self.samples.items()
-            if samples
+            s: {k: v * 1e3 for k, v in child.percentiles().items()}
+            for s, child in self._children.items()
+            if child.count
         }
 
 
 @contextlib.contextmanager
 def device_trace(log_dir: Optional[str]) -> Iterator[None]:
     """Capture an XLA device trace under ``log_dir`` (viewable in
-    TensorBoard's profile tab / Perfetto). No-op when ``log_dir`` is None."""
+    TensorBoard's profile tab / Perfetto). No-op when ``log_dir`` is None.
+    For captures triggered on a RUNNING process, see
+    ``telemetry.device.capture_device_trace`` and its serving/supervisor
+    hooks."""
     if log_dir is None:
         yield
         return

@@ -303,6 +303,12 @@ def main(argv=None) -> int:
         },
         "results": results,
         "invariants": invariants,
+        # each worker's registry snapshot (same series a live scrape would
+        # show) — bench artifacts and /metrics share one definition
+        "telemetry": {
+            "oracle": oracle.get("telemetry"),
+            "recovered": (final or {}).get("telemetry"),
+        },
         "ok": ok,
     }
     text = json.dumps(payload, indent=2)

@@ -185,6 +185,13 @@ def run_bench(args) -> dict:
         )
         metrics = service.metrics()
         service.close()
+        # snapshot HERE, before the overload/compare phases observe into
+        # the same process-wide series: the artifact's telemetry section
+        # must agree with its own main-phase latency_ms, not mix in
+        # tight-deadline overload traffic
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        telemetry_snapshot = get_registry().snapshot()
 
         # -- overload phase: more clients than queue slots, tight deadlines;
         # every submit must still get exactly one explicit result
@@ -289,6 +296,10 @@ def run_bench(args) -> dict:
         },
         "overload": overload,
         "compare": compare,
+        # the same registry series a /metrics?format=prom scrape would
+        # have exposed at the end of the MAIN phase — one definition for
+        # bench artifacts and live metrics
+        "telemetry": telemetry_snapshot,
         "invariants": {
             "zero_lost": lost == 0 and errors == 0,
             "overload_zero_lost": (
@@ -341,6 +352,13 @@ def main(argv=None) -> int:
     p.add_argument("--compilation-cache", default=None, metavar="DIR",
                    help="persistent XLA compile cache dir (restarts reuse "
                         "AOT artifacts)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable span tracing for the bench run (metrics "
+                        "are always on)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write a Chrome trace of the bench run here "
+                        "(implies --telemetry; fold it with "
+                        "scripts/trace_report.py)")
     p.add_argument("--output", default=os.path.join(_REPO, "artifacts", "serve_bench.json"))
     args = p.parse_args(argv)
 
@@ -372,7 +390,15 @@ def main(argv=None) -> int:
 
         enable_compilation_cache(args.compilation_cache)
 
+    from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
+    if args.telemetry or args.trace:
+        TRACER.enable()
+
     summary = run_bench(args)
+    if args.trace:
+        TRACER.dump(args.trace, {"source": "serve_bench",
+                                 "smoke": bool(args.smoke)})
     os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
     with open(args.output, "w") as fh:
         json.dump(summary, fh, indent=2)

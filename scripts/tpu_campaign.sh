@@ -30,10 +30,24 @@ fi
 # serving replica must be able to load and serve — refuse to start if the
 # serve path regressed (zero-lost / bounded-compile / no-serve-time-compile
 # invariants, enforced by serve_bench's own exit code). Pinned to CPU so it
-# never touches the chip the campaign is about to hold.
+# never touches the chip the campaign is about to hold. The smoke runs with
+# span tracing ON and captures a Chrome trace artifact — the telemetry
+# plane itself is gated (docs/OBSERVABILITY.md).
 if ! JAX_PLATFORMS=cpu timeout 600 python scripts/serve_bench.py --smoke \
+    --trace artifacts/serve_bench_smoke_trace.json \
     --output artifacts/serve_bench_smoke.json > serve_bench_smoke.log 2>&1; then
   echo "$(date +%H:%M:%S) serve_bench smoke failed — campaign aborted (see serve_bench_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
+# Trace gate: fold the smoke's Chrome trace into the occupancy report.
+# trace_report exits nonzero on a missing, malformed, or span-free trace —
+# a telemetry regression that silently stops recording must abort here,
+# not be discovered after the chip-hours are spent.
+if ! timeout 120 python scripts/trace_report.py \
+    artifacts/serve_bench_smoke_trace.json \
+    --json artifacts/serve_bench_smoke_trace_report.json \
+    > trace_report.log 2>&1; then
+  echo "$(date +%H:%M:%S) trace_report gate failed — campaign aborted (see trace_report.log)" >> tpu_poller.log
   exit 1
 fi
 # Resilience smoke (CPU, subprocess kill drill): the campaign's long runs

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""trace_report — fold a Chrome trace into occupancy + top-spans tables.
+
+Consumes the Chrome trace-event JSON the telemetry span tracer exports
+(``Tracer.dump``, ``serve_bench --trace``, the serving API's
+``GET /debug/spans``, the resilience worker's ``--span-trace``), or any
+file in the same format, and answers the two questions a wall of spans
+hides:
+
+1. **per-phase occupancy** — for each span name: total busy seconds, how
+   much of the trace's wall span that is, call count, mean and max. The
+   "phase" is the span name's dotted prefix family (``serve.batcher.*``,
+   ``resilience.*``), so the report reads as a plane-by-plane budget.
+2. **top spans** — the N longest individual spans with their timestamps
+   and correlation args: the tail-latency forensics view.
+
+Exit status is the campaign-gate contract: nonzero when the file is
+missing, malformed, or contains no complete spans — an empty trace
+artifact must FAIL the pipeline that was supposed to produce one, not
+pass silently (``scripts/tpu_campaign.sh`` runs this over the serve-bench
+smoke's trace).
+
+Stdlib-only; works anywhere, including jax-free containers.
+
+Usage::
+
+    python scripts/trace_report.py artifacts/serve_trace.json
+    python scripts/trace_report.py trace.json --top 20 --json report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> list:
+    """The trace's event list. Accepts both the object form
+    (``{"traceEvents": [...]}``) and the bare-array form the Chrome
+    format also allows. Raises ValueError on anything else."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        raise ValueError(f"not a Chrome trace: top-level {type(doc).__name__}")
+    if not isinstance(events, list):
+        raise ValueError("not a Chrome trace: no traceEvents array")
+    return events
+
+
+def validate(events: list) -> list:
+    """Schema check — every event needs ph/ts/pid/tid and a name; returns
+    the list of violations (empty = valid)."""
+    problems = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            if field not in ev:
+                problems.append(f"event {i} ({ev.get('name', '?')}): "
+                                f"missing {field!r}")
+        if ev.get("ph") == "X" and "dur" not in ev:
+            problems.append(f"event {i} ({ev.get('name', '?')}): "
+                            f"complete event without dur")
+    return problems
+
+
+def _pair_async(events: list) -> list:
+    """Synthesize (name, ts, dur, args) rows for async b/e pairs keyed by
+    (name, id) — the batcher's cross-thread flight spans."""
+    open_by_key: dict = {}
+    rows = []
+    for ev in events:
+        if ev.get("ph") == "b":
+            open_by_key[(ev["name"], ev.get("id"))] = ev
+        elif ev.get("ph") == "e":
+            begin = open_by_key.pop((ev["name"], ev.get("id")), None)
+            if begin is not None:
+                rows.append({
+                    "name": ev["name"],
+                    "ts": begin["ts"],
+                    "dur": max(0.0, ev["ts"] - begin["ts"]),
+                    "args": {**(begin.get("args") or {}),
+                             **(ev.get("args") or {})},
+                })
+    return rows
+
+
+def fold(events: list, top_n: int = 10) -> dict:
+    """The report payload: wall span, per-name occupancy, top spans."""
+    spans = [
+        {"name": ev["name"], "ts": ev["ts"], "dur": ev.get("dur", 0.0),
+         "args": ev.get("args") or {}}
+        for ev in events if ev.get("ph") == "X"
+    ]
+    spans += _pair_async(events)
+    if not spans:
+        raise ValueError("trace holds no complete spans (ph=X or b/e pairs)")
+    all_ts = [ev["ts"] for ev in events if isinstance(ev.get("ts"), (int, float))]
+    wall_us = max(
+        max((s["ts"] + s["dur"]) for s in spans),
+        max(all_ts),
+    ) - min(all_ts)
+    wall_us = max(wall_us, 1e-9)
+
+    by_name: dict = defaultdict(lambda: {"busy_us": 0.0, "count": 0,
+                                         "max_us": 0.0})
+    for s in spans:
+        agg = by_name[s["name"]]
+        agg["busy_us"] += s["dur"]
+        agg["count"] += 1
+        agg["max_us"] = max(agg["max_us"], s["dur"])
+    phases = {}
+    for name, agg in by_name.items():
+        phases[name] = {
+            "busy_s": agg["busy_us"] / 1e6,
+            "count": agg["count"],
+            "mean_ms": agg["busy_us"] / agg["count"] / 1e3,
+            "max_ms": agg["max_us"] / 1e3,
+            "occupancy": agg["busy_us"] / wall_us,
+        }
+
+    top = sorted(spans, key=lambda s: -s["dur"])[:top_n]
+    return {
+        "wall_s": wall_us / 1e6,
+        "events": len(events),
+        "spans": len(spans),
+        "phases": dict(sorted(phases.items(),
+                              key=lambda kv: -kv[1]["busy_s"])),
+        "top_spans": [
+            {"name": s["name"], "start_us": s["ts"], "dur_ms": s["dur"] / 1e3,
+             "args": s["args"]}
+            for s in top
+        ],
+    }
+
+
+def render(report: dict) -> str:
+    out = [
+        f"wall {report['wall_s']:.3f}s — {report['events']} events, "
+        f"{report['spans']} spans",
+        "",
+        f"{'span name':>32s}  {'busy s':>9s}  {'occ':>6s}  {'n':>6s}  "
+        f"{'mean ms':>9s}  {'max ms':>9s}",
+    ]
+    for name, p in report["phases"].items():
+        out.append(
+            f"{name:>32s}  {p['busy_s']:9.3f}  {p['occupancy']:6.1%}  "
+            f"{p['count']:6d}  {p['mean_ms']:9.3f}  {p['max_ms']:9.3f}"
+        )
+    out.append("")
+    out.append("top spans:")
+    for s in report["top_spans"]:
+        args = {k: v for k, v in s["args"].items() if k != "riders"}
+        out.append(f"  {s['dur_ms']:9.3f}ms  {s['name']:<28s}  {args}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="Chrome trace-event JSON file")
+    p.add_argument("--top", type=int, default=10,
+                   help="longest individual spans to list")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the report as JSON")
+    args = p.parse_args(argv)
+
+    try:
+        events = load_events(args.trace)
+        problems = validate(events)
+        if problems:
+            for line in problems[:20]:
+                sys.stderr.write(f"trace_report: {line}\n")
+            sys.stderr.write(
+                f"trace_report: {args.trace}: {len(problems)} schema "
+                f"violation(s)\n")
+            return 1
+        report = fold(events, top_n=args.top)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        sys.stderr.write(f"trace_report: {args.trace}: {exc}\n")
+        return 1
+    print(render(report))
+    if args.json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
+                    exist_ok=True)
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+            fh.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

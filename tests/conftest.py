@@ -36,3 +36,29 @@ def rng():
     import jax
 
     return jax.random.PRNGKey(666)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Fresh process-wide metrics registry per test (and a quiet tracer).
+
+    The telemetry registry is process-wide BY DESIGN (one serving process =
+    one registry); a pytest process runs hundreds of "processes" worth of
+    engines and batchers back to back, so without this swap every test
+    would read the previous tests' series. Swapping the default registry
+    gives each test the single-process view production sees. The span
+    tracer is a disabled-by-default singleton; tests that enable it get it
+    disabled and drained again afterwards."""
+    from gan_deeplearning4j_tpu.telemetry.registry import (
+        MetricsRegistry,
+        set_registry,
+    )
+    from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
+    previous = set_registry(MetricsRegistry())
+    try:
+        yield
+    finally:
+        set_registry(previous)
+        TRACER.disable()
+        TRACER.clear()

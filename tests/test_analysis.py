@@ -1422,6 +1422,162 @@ class TestCrossModulePrngReuse:
 
 
 # ===========================================================================
+# JG015 — unfenced clock delta fed to a telemetry sink
+# ===========================================================================
+
+class TestTelemetryUnfencedTiming:
+    def test_true_positive_inline_delta_to_observe(self):
+        # the hazard the telemetry plane makes one line to write: a
+        # perf-counter delta around a jitted call, observed into a
+        # histogram with no fence — the metric records dispatch latency
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def f(step, x, hist):\n"
+            "    jf = jax.jit(step)\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jf(x)\n"
+            "    hist.observe(time.perf_counter() - t0)\n"
+            "    return y\n"
+        )
+        assert codes(r) == ["JG015"]
+        assert "dispatch, not execution" in r.active[0].message
+
+    def test_true_positive_named_delta_to_stage_add(self):
+        # the StageStats.add shape, with the delta bound to a name first
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def f(step, x, stats):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jax.jit(step)(x)\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    stats.add('device', dt)\n"
+            "    return y\n"
+        )
+        assert codes(r) == ["JG015"]
+
+    def test_true_positive_cross_module_traced_callee(self):
+        # the jit lives a module away: the project index's traced-ness
+        # summary is what convicts the call site
+        r = analyze_sources({
+            "pkg/steps.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def train_step(x):\n"
+                "    return x * 2\n"
+            ),
+            "pkg/loop.py": (
+                "import time\n"
+                "from pkg.steps import train_step\n"
+                "def run(x, hist):\n"
+                "    t0 = time.perf_counter()\n"
+                "    y = train_step(x)\n"
+                "    hist.observe(time.perf_counter() - t0)\n"
+                "    return y\n"
+            ),
+        })
+        assert codes(r) == ["JG015"]
+
+    def test_true_positive_fence_after_the_delta_is_too_late(self):
+        # the delta was captured BEFORE the fence ran: block_until_ready
+        # between the delta and the sink cannot un-poison the measurement
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def f(step, x, hist):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jax.jit(step)(x)\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    jax.block_until_ready(y)\n"
+            "    hist.observe(dt)\n"
+            "    return y\n"
+        )
+        assert codes(r) == ["JG015"]
+
+    def test_true_negative_fenced_output(self):
+        # the corrected idiom: fence THE CALL'S OWN output before the
+        # second clock read (JG002's contract)
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def f(step, x, hist):\n"
+            "    jf = jax.jit(step)\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jf(x)\n"
+            "    jax.block_until_ready(y)\n"
+            "    hist.observe(time.perf_counter() - t0)\n"
+            "    return y\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_inline_asarray_fence(self):
+        r = run(
+            "import time\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "def f(step, x, hist):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = np.asarray(jax.jit(step)(x))\n"
+            "    hist.observe(time.perf_counter() - t0)\n"
+            "    return y\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_untraced_work(self):
+        # the store's publish timing: fsync-bound host work, no device
+        # async to fence — the delta is honest
+        r = run(
+            "import time\n"
+            "def publish(write, staging, hist):\n"
+            "    t0 = time.perf_counter()\n"
+            "    write(staging)\n"
+            "    hist.observe(time.perf_counter() - t0)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_delta_into_plain_dict(self):
+        # summaries/event lists are not scrape sinks; JG002/JG009 own the
+        # general timed-region cases
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def f(step, x, out):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jax.jit(step)(x)\n"
+            "    out['train_s'] = time.perf_counter() - t0\n"
+            "    return y\n"
+        )
+        assert codes(r) == []
+
+    def test_skips_test_modules(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def test_speed(step, x, hist):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jax.jit(step)(x)\n"
+            "    hist.observe(time.perf_counter() - t0)\n"
+            "    return y\n",
+            path="tests/test_speed.py",
+        )
+        assert codes(r) == []
+
+    def test_suppression_applies(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def f(step, x, hist):\n"
+            "    t0 = time.perf_counter()\n"
+            "    y = jax.jit(step)(x)\n"
+            "    hist.observe(time.perf_counter() - t0)  # jaxlint: disable=JG015\n"
+            "    return y\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG015"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

@@ -1,0 +1,743 @@
+"""Unified telemetry plane tests (docs/OBSERVABILITY.md).
+
+Covers the ISSUE-6 acceptance surface: trace-export schema validity (every
+event carries ph/ts/pid/tid, spans nest, correlation ids survive the
+batcher's two-stage pipeline), registry thread-safety under the batcher's
+worker+completer threads, a Prometheus exposition golden test, the
+overhead smoke (telemetry-off serve path allocates no registry series and
+records no events; telemetry-on stays inside the 5%-of-wall budget on a
+sleep-dominated fake engine), the serving surface (`generation` in
+/healthz and /metrics, `?format=prom`, /debug/spans, /debug/trace device
+captures, SIGUSR2), and the end-to-end drive: one Chrome trace showing a
+supervisor training segment publishing a generation and a serving request
+consuming it, with correlated spans across both planes.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.nn import (
+    DenseLayer,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.serving import (
+    InferenceService,
+    MicroBatcher,
+    ServingEngine,
+    make_server,
+)
+from gan_deeplearning4j_tpu.telemetry.registry import (
+    MetricsRegistry,
+    get_registry,
+    percentiles,
+)
+from gan_deeplearning4j_tpu.telemetry.trace import (
+    TRACER,
+    Tracer,
+    bind_trace_id,
+    new_trace_id,
+    unbind_trace_id,
+)
+from gan_deeplearning4j_tpu.utils import write_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+Z, FEAT, CLASSES = 4, 6, 3
+
+
+def _checkpoints(tmp_path):
+    b = GraphBuilder(GraphConfig(seed=1))
+    b.add_inputs("z").set_input_types(InputType.feed_forward(Z))
+    b.add_layer("g_dense_1", DenseLayer(n_out=8), "z")
+    b.add_layer(
+        "g_out", OutputLayer(n_out=FEAT, activation="sigmoid", loss="xent"),
+        "g_dense_1",
+    )
+    b.set_outputs("g_out")
+    gen = b.build()
+    b = GraphBuilder(GraphConfig(seed=2))
+    b.add_inputs("x").set_input_types(InputType.feed_forward(FEAT))
+    b.add_layer("feat_1", DenseLayer(n_out=5), "x")
+    b.add_layer(
+        "cv_out",
+        OutputLayer(n_out=CLASSES, activation="softmax", loss="mcxent"),
+        "feat_1",
+    )
+    b.set_outputs("cv_out")
+    cv = b.build()
+    gen_path = str(tmp_path / "gen.zip")
+    cv_path = str(tmp_path / "cv.zip")
+    write_model(gen_path, gen, gen.init(), save_updater=False)
+    write_model(cv_path, cv, cv.init(), save_updater=False)
+    return gen_path, cv_path
+
+
+# ===========================================================================
+# one percentile definition across the repo
+# ===========================================================================
+
+class TestOneDefinition:
+    def test_profiling_percentiles_is_the_registry_function(self):
+        from gan_deeplearning4j_tpu.utils import profiling
+
+        assert profiling.percentiles is percentiles
+
+    def test_nearest_rank_contract_unchanged(self):
+        # the PR 3 definition: nearest-rank over sorted samples
+        assert percentiles([4.0, 1.0, 3.0, 2.0], (50,)) == {"p50": 2.0}
+        assert percentiles([], (50,)) == {}
+        out = percentiles(range(1, 101))
+        assert out == {"p50": 50.0, "p95": 95.0, "p99": 99.0}
+
+
+# ===========================================================================
+# metrics registry
+# ===========================================================================
+
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", "help", labelnames=("kind",))
+        c.labels(kind="a").inc()
+        c.labels(kind="a").inc(2)
+        c.labels(kind="b").inc()
+        assert c.labels(kind="a").value == 3
+        assert c.labels(kind="b").value == 1
+        g = reg.gauge("g")
+        g.set(5)
+        g.dec(2)
+        assert g.labels().value == 3
+        h = reg.histogram("h")
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        child = h.labels()
+        assert child.count == 3 and abs(child.total - 0.6) < 1e-9
+        assert child.percentiles((50,)) == {"p50": 0.2}
+
+    def test_reregistration_is_idempotent_conflict_raises(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x_total", labelnames=("kind",))
+        b = reg.counter("x_total", labelnames=("kind",))
+        assert a is b
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("x_total")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.counter("x_total", labelnames=("other",))
+
+    def test_counters_only_go_up(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="only go up"):
+            reg.counter("c_total").labels().inc(-1)
+
+    def test_unknown_labels_rejected(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("c_total", labelnames=("kind",))
+        with pytest.raises(ValueError, match="expected labels"):
+            fam.labels(wrong="x")
+
+    def test_thread_safety_under_concurrent_writers(self):
+        """The batcher updates series from its worker AND completer
+        threads; `x += 1` interleaves at the bytecode level, so the series
+        lock must make every increment land."""
+        reg = MetricsRegistry()
+        child = reg.counter("t_total", labelnames=("kind",)).labels(kind="x")
+        hist = reg.histogram("t_seconds").labels()
+        n, per = 8, 5000
+
+        def work():
+            for _ in range(per):
+                child.inc()
+                hist.observe(0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert child.value == n * per
+        assert hist.count == n * per
+
+    def test_snapshot_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "help", labelnames=("kind",)).labels(
+            kind="a").inc()
+        reg.histogram("h_seconds").observe(0.5)
+        snap = reg.snapshot()
+        assert snap["c_total"]["type"] == "counter"
+        assert snap["c_total"]["series"] == [
+            {"labels": {"kind": "a"}, "value": 1.0}]
+        hrow = snap["h_seconds"]["series"][0]
+        assert hrow["count"] == 1 and hrow["p50"] == 0.5
+
+
+class TestPrometheus:
+    def test_golden_exposition(self):
+        reg = MetricsRegistry()
+        reg.histogram("lat_seconds", "lat").observe(0.25)
+        reg.gauge("queue_depth", "depth").set(2)
+        reg.counter("requests_total", "reqs",
+                    labelnames=("kind", "status")).labels(
+            kind="sample", status="ok").inc(3)
+        expected = (
+            '# HELP lat_seconds lat\n'
+            '# TYPE lat_seconds summary\n'
+            'lat_seconds{quantile="0.5"} 0.25\n'
+            'lat_seconds{quantile="0.95"} 0.25\n'
+            'lat_seconds{quantile="0.99"} 0.25\n'
+            'lat_seconds_sum 0.25\n'
+            'lat_seconds_count 1\n'
+            '# HELP queue_depth depth\n'
+            '# TYPE queue_depth gauge\n'
+            'queue_depth 2\n'
+            '# HELP requests_total reqs\n'
+            '# TYPE requests_total counter\n'
+            'requests_total{kind="sample",status="ok"} 3\n'
+        )
+        assert reg.to_prometheus() == expected
+
+    def test_label_values_escaped(self):
+        reg = MetricsRegistry()
+        reg.counter("e_total", labelnames=("why",)).labels(
+            why='say "hi"\\\n').inc()
+        text = reg.to_prometheus()
+        assert r'why="say \"hi\"\\\n"' in text
+
+    def test_metric_names_sanitized(self):
+        reg = MetricsRegistry()
+        reg.counter("bad-name.total").inc()
+        assert "bad_name_total 1" in reg.to_prometheus()
+
+
+# ===========================================================================
+# span tracer
+# ===========================================================================
+
+class TestTracer:
+    def test_span_event_schema(self):
+        tr = Tracer(enabled=True)
+        with tr.span("work", gen=7):
+            time.sleep(0.002)
+        (ev,) = tr.events()
+        for field in ("name", "ph", "ts", "pid", "tid", "dur"):
+            assert field in ev
+        assert ev["ph"] == "X" and ev["name"] == "work"
+        assert ev["pid"] == os.getpid()
+        assert ev["dur"] >= 2000  # µs
+        assert ev["args"]["gen"] == 7
+
+    def test_spans_nest(self):
+        tr = Tracer(enabled=True)
+        with tr.span("outer"):
+            time.sleep(0.001)
+            with tr.span("inner"):
+                time.sleep(0.001)
+            time.sleep(0.001)
+        by_name = {e["name"]: e for e in tr.events()}
+        outer, inner = by_name["outer"], by_name["inner"]
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+
+    def test_disabled_records_nothing_and_allocates_no_span(self):
+        tr = Tracer()
+        assert tr.span("a") is tr.span("b")  # the shared no-op object
+        with tr.span("a"):
+            pass
+        tr.complete("x", 0.0, 1.0)
+        tr.instant("y")
+        tr.async_begin("z", "1")
+        tr.async_end("z", "1")
+        assert len(tr) == 0 and tr.events() == []
+
+    def test_ring_buffer_bounds_memory(self):
+        tr = Tracer(capacity=4, enabled=True)
+        for i in range(10):
+            tr.instant(f"e{i}")
+        assert len(tr) == 4
+        assert tr.dropped == 6
+        assert [e["name"] for e in tr.events()] == ["e6", "e7", "e8", "e9"]
+
+    def test_async_begin_end_pair(self):
+        tr = Tracer(enabled=True)
+        tr.async_begin("flight", "f-1", {"kind": "sample"})
+        tr.async_end("flight", "f-1", {"status": "ok"})
+        b, e = tr.events()
+        assert (b["ph"], e["ph"]) == ("b", "e")
+        assert b["id"] == e["id"] == "f-1"
+
+    def test_contextvar_correlation_lands_in_args(self):
+        tr = Tracer(enabled=True)
+        token = bind_trace_id("req-42")
+        try:
+            tr.instant("hop")
+        finally:
+            unbind_trace_id(token)
+        tr.instant("after")
+        hop, after = tr.events()
+        assert hop["args"]["trace_id"] == "req-42"
+        assert "args" not in after or "trace_id" not in after.get("args", {})
+
+    def test_dump_writes_loadable_chrome_trace(self, tmp_path):
+        tr = Tracer(enabled=True)
+        with tr.span("alpha"):
+            pass
+        path = tr.dump(str(tmp_path / "t.json"), {"source": "test"})
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+        assert doc["metadata"]["source"] == "test"
+        assert doc["displayTimeUnit"] == "ms"
+
+    def test_trace_ids_are_process_unique(self):
+        a, b = new_trace_id(), new_trace_id()
+        assert a != b and a.startswith(f"{os.getpid():x}-")
+
+
+# ===========================================================================
+# batcher pipeline: correlation survives worker+completer threads
+# ===========================================================================
+
+class TestBatcherTelemetry:
+    def _drive(self, n=4):
+        TRACER.enable()
+        mb = MicroBatcher(run_fn=lambda k, r: r, max_batch=8,
+                          max_latency=0.0)
+        token = bind_trace_id("req-under-test")
+        try:
+            for _ in range(n):
+                res = mb.submit("k", np.ones((1, 3), np.float32))
+                assert res.ok
+        finally:
+            unbind_trace_id(token)
+        mb.close()
+        return mb, TRACER.events()
+
+    def test_all_pipeline_stages_emit_spans(self):
+        _, events = self._drive()
+        names = {e["name"] for e in events}
+        assert {"serve.batcher.submit", "serve.batcher.cut",
+                "serve.batcher.dispatch", "serve.batcher.finalize",
+                "serve.batcher.scatter", "serve.flight"} <= names
+
+    def test_correlation_id_survives_both_thread_handoffs(self):
+        """submit (caller thread) → cut/dispatch (worker thread) →
+        finalize/scatter (completer thread): the id minted at submit must
+        appear in every stage's args even though contextvars do not cross
+        threads."""
+        _, events = self._drive(n=1)
+        by_name = {e["name"]: e for e in events}
+        rid = by_name["serve.batcher.submit"]["args"]["trace_id"]
+        assert rid == "req-under-test"
+        assert rid in by_name["serve.batcher.cut"]["args"]["riders"]
+        assert rid in by_name["serve.batcher.dispatch"]["args"]["riders"]
+        assert rid in by_name["serve.batcher.scatter"]["args"]["riders"]
+        # and the stages really ran on three distinct threads
+        tids = {by_name[n]["tid"] for n in
+                ("serve.batcher.submit", "serve.batcher.cut",
+                 "serve.batcher.scatter")}
+        assert len(tids) == 3
+
+    def test_flight_async_pair_brackets_the_flush(self):
+        _, events = self._drive(n=1)
+        begins = [e for e in events
+                  if e["name"] == "serve.flight" and e["ph"] == "b"]
+        ends = [e for e in events
+                if e["name"] == "serve.flight" and e["ph"] == "e"]
+        assert len(begins) == len(ends) == 1
+        assert begins[0]["id"] == ends[0]["id"]
+        assert begins[0]["tid"] != ends[0]["tid"]  # worker vs completer
+
+    def test_every_event_is_schema_valid(self):
+        _, events = self._drive()
+        for ev in events:
+            for field in ("name", "ph", "ts", "pid", "tid"):
+                assert field in ev, ev
+            if ev["ph"] == "X":
+                assert "dur" in ev
+
+    def test_latency_percentiles_come_from_the_registry_histogram(self):
+        mb, _ = self._drive(n=6)
+        fam = get_registry().histogram(
+            "serve_request_latency_seconds",
+            labelnames=("kind",))
+        child = fam.labels(kind="k")
+        assert child.count == 6
+        lat = mb.metrics()["latency_ms"]["k"]
+        assert set(lat) == {"p50", "p95", "p99"}
+        assert abs(lat["p50"] - child.percentiles((50,))["p50"] * 1e3) < 1e-9
+
+    def test_registry_counters_mirror_the_ledger(self):
+        mb, _ = self._drive(n=5)
+        snap = get_registry().snapshot()
+        ok_rows = [s for s in snap["serve_requests_total"]["series"]
+                   if s["labels"] == {"kind": "k", "status": "ok"}]
+        assert ok_rows and ok_rows[0]["value"] == 5
+        assert snap["serve_flushes_total"]["series"][0]["value"] == \
+            mb.metrics()["flushes"]
+        assert "serve_stage_seconds" in snap
+
+    def test_metrics_json_schema_is_preserved(self):
+        mb, _ = self._drive()
+        m = mb.metrics()
+        for key in ("submitted", "completed", "shed_overloaded",
+                    "shed_deadline", "errors", "flushes", "queue_depth",
+                    "batch_occupancy", "latency_ms", "pipeline"):
+            assert key in m
+        assert m["submitted"] == {"k": 4} and m["completed"] == {"k": 4}
+
+
+# ===========================================================================
+# overhead smoke: off = nothing; on = inside the 5% budget
+# ===========================================================================
+
+class TestOverhead:
+    def test_disabled_path_allocates_no_registry_series_or_events(self):
+        mb = MicroBatcher(run_fn=lambda k, r: r, max_batch=8,
+                          max_latency=0.0)
+        # warm: the first request of a kind creates its series once
+        assert mb.submit("k", np.ones((1, 3), np.float32)).ok
+        reg = get_registry()
+        baseline = reg.series_count()
+        for _ in range(25):
+            assert mb.submit("k", np.ones((1, 3), np.float32)).ok
+        mb.close()
+        assert reg.series_count() == baseline  # steady state: no new series
+        assert len(TRACER) == 0  # tracing off: nothing recorded
+        assert TRACER.span("a") is TRACER.span("b")  # no span objects either
+
+    def test_enabled_overhead_within_budget_on_fake_engine(self):
+        """Paired off/on rounds over a sleep-dominated fake engine (the
+        pipelining tests' workload shape). Budget: telemetry-on within 5%
+        of wall, with an absolute floor of 500 µs/request. Timing noise on
+        a loaded CI box only ever ADDS time, so each estimate is the MIN
+        of several alternating rounds, and a noisy attempt (where even the
+        mins were perturbed) gets retried — the test proves an upper bound
+        on overhead exists, and one clean measurement suffices for that;
+        real per-request cost (a handful of dict/event appends, ~tens of
+        µs) sits an order of magnitude under the gate."""
+        n = 40
+        rows = np.ones((1, 3), np.float32)
+
+        def run_round(enabled):
+            if enabled:
+                TRACER.enable()
+            else:
+                TRACER.disable()
+            mb = MicroBatcher(
+                run_fn=lambda k, r: (time.sleep(0.002), r)[1],
+                max_batch=8, max_latency=0.0)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                assert mb.submit("k", rows).ok
+            elapsed = time.perf_counter() - t0
+            mb.close()
+            return elapsed
+
+        on = off = per_request = 0.0
+        for attempt in range(3):
+            offs, ons = [], []
+            for _ in range(3):
+                offs.append(run_round(False))
+                ons.append(run_round(True))
+            TRACER.disable()
+            off, on = min(offs), min(ons)
+            per_request = (on - off) / n
+            if on <= off * 1.05 or per_request < 500e-6:
+                return
+        assert on <= off * 1.05 or per_request < 500e-6, (
+            f"telemetry-on {on:.4f}s vs off {off:.4f}s "
+            f"({per_request * 1e6:.0f}µs/request over budget in all "
+            f"attempts)")
+
+
+# ===========================================================================
+# serving surface: generation, prom exposition, debug hooks
+# ===========================================================================
+
+class TestServingSurface:
+    def _service(self, tmp_path, generation=None, **kw):
+        gen_path, cv_path = _checkpoints(tmp_path)
+        engine = ServingEngine.from_checkpoints(
+            generator=gen_path, classifier=cv_path, buckets=(1, 8),
+            feature_vertex="feat_1", generation=generation,
+        )
+        return InferenceService(engine, warmup=False, max_latency=0.0, **kw)
+
+    def test_generation_surfaces_in_healthz_and_metrics(self, tmp_path):
+        svc = self._service(tmp_path, generation=7)
+        try:
+            assert svc.healthz()["generation"] == 7
+            m = svc.metrics()
+            assert m["generation"] == 7
+            assert m["engine"]["generation"] == 7
+            snap = get_registry().snapshot()
+            assert snap["serving_generation"]["series"][0]["value"] == 7
+        finally:
+            svc.close()
+
+    def test_unversioned_engine_reports_generation_none(self, tmp_path):
+        svc = self._service(tmp_path)
+        try:
+            assert svc.healthz()["generation"] is None
+            assert svc.metrics()["generation"] is None
+        finally:
+            svc.close()
+
+    def test_prometheus_exposition_over_http(self, tmp_path):
+        import urllib.request
+
+        svc = self._service(tmp_path, generation=3)
+        server = make_server(svc, port=0)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics?format=prom",
+                    timeout=10) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            assert "# TYPE serve_queue_depth gauge" in text
+            assert "serving_generation 3" in text
+            # the JSON payload still answers without the format knob
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                body = json.loads(r.read())
+            assert body["generation"] == 3
+        finally:
+            server.shutdown()
+            server.server_close()
+            svc.close()
+
+    def test_debug_spans_exports_chrome_trace(self, tmp_path):
+        TRACER.enable()
+        svc = self._service(tmp_path)
+        try:
+            TRACER.instant("marker")
+            status, body = svc.handle("GET", "/debug/spans")
+            assert status == 200
+            assert any(e["name"] == "marker" for e in body["traceEvents"])
+        finally:
+            svc.close()
+
+    def test_debug_trace_captures_device_profile(self, tmp_path):
+        """Async by default: 202 answers immediately with the path the
+        artifact WILL land at (cold profiler start/stop can take tens of
+        seconds — no HTTP client should wait through that)."""
+        artifacts = str(tmp_path / "captures")
+        svc = self._service(tmp_path, artifacts_dir=artifacts)
+        try:
+            status, body = svc.handle("POST", "/debug/trace?ms=40")
+            assert status == 202, body
+            out = body["artifact"]
+            assert out.startswith(artifacts)
+            deadline = time.monotonic() + 120.0
+            captured = []
+            while time.monotonic() < deadline and not captured:
+                captured = [
+                    os.path.join(root, f)
+                    for root, _, files in os.walk(out) for f in files
+                ]
+                time.sleep(0.05)
+            assert captured, "capture produced no profiler artifacts"
+        finally:
+            svc.close()
+
+    def test_debug_trace_block_mode_waits_for_the_artifact(self, tmp_path):
+        artifacts = str(tmp_path / "captures_block")
+        svc = self._service(tmp_path, artifacts_dir=artifacts)
+        try:
+            status, body = svc.handle("POST", "/debug/trace?ms=30&block=1")
+            assert status == 200, body
+            out = body["artifact"]
+            assert os.path.isdir(out)
+            assert any(files for _, _, files in os.walk(out))
+        finally:
+            svc.close()
+
+    def test_debug_trace_rejects_bad_duration(self, tmp_path):
+        svc = self._service(tmp_path, artifacts_dir=str(tmp_path / "c"))
+        try:
+            assert svc.handle("POST", "/debug/trace?ms=nope")[0] == 400
+            assert svc.handle("POST", "/debug/trace?ms=0")[0] == 400
+            assert svc.handle("POST", "/debug/trace?ms=999999")[0] == 400
+        finally:
+            svc.close()
+
+
+class TestSignalCapture:
+    def test_sigusr2_triggers_background_capture(self, tmp_path):
+        from gan_deeplearning4j_tpu.telemetry.device import (
+            install_signal_capture,
+        )
+
+        artifacts = str(tmp_path / "sig")
+        old = signal.getsignal(signal.SIGUSR2)
+        try:
+            install_signal_capture(artifacts, duration_ms=30)
+            os.kill(os.getpid(), signal.SIGUSR2)
+            deadline = time.monotonic() + 10.0
+            files = []
+            while time.monotonic() < deadline and not files:
+                files = [
+                    os.path.join(root, f)
+                    for root, _, fs in os.walk(artifacts) for f in fs
+                ]
+                time.sleep(0.05)
+            assert files, "SIGUSR2 produced no capture artifacts"
+        finally:
+            signal.signal(signal.SIGUSR2, old)
+
+
+# ===========================================================================
+# trace_report: the campaign gate
+# ===========================================================================
+
+class TestTraceReport:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+             *argv],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+
+    def test_folds_a_real_trace(self, tmp_path):
+        tr = Tracer(enabled=True)
+        with tr.span("serve.batcher.dispatch", kind="sample"):
+            time.sleep(0.002)
+        with tr.span("serve.batcher.finalize", kind="sample"):
+            time.sleep(0.001)
+        path = tr.dump(str(tmp_path / "trace.json"))
+        proc = self._run(path, "--json", str(tmp_path / "report.json"))
+        assert proc.returncode == 0, proc.stderr
+        assert "serve.batcher.dispatch" in proc.stdout
+        with open(tmp_path / "report.json") as fh:
+            report = json.load(fh)
+        assert report["spans"] == 2
+        assert report["phases"]["serve.batcher.dispatch"]["count"] == 1
+
+    def test_empty_trace_fails_the_gate(self, tmp_path):
+        path = tmp_path / "empty.json"
+        path.write_text('{"traceEvents": []}\n')
+        proc = self._run(str(path))
+        assert proc.returncode == 1
+        assert "no complete spans" in proc.stderr
+
+    def test_malformed_trace_fails_the_gate(self, tmp_path):
+        path = tmp_path / "broken.json"
+        path.write_text("{not json\n")
+        assert self._run(str(path)).returncode == 1
+        path2 = tmp_path / "schema.json"
+        path2.write_text(json.dumps(
+            {"traceEvents": [{"name": "x", "ph": "X", "ts": 1.0}]}))
+        proc = self._run(str(path2))
+        assert proc.returncode == 1
+        assert "missing" in proc.stderr
+
+    def test_missing_file_fails_the_gate(self, tmp_path):
+        assert self._run(str(tmp_path / "nope.json")).returncode == 1
+
+
+# ===========================================================================
+# the end-to-end drive: train → publish generation → serve it, one trace
+# ===========================================================================
+
+class TestEndToEndTrace:
+    def test_supervisor_publish_and_serving_consume_share_one_trace(
+            self, tmp_path):
+        """ISSUE-6 acceptance: a supervisor training segment publishes a
+        generation, a serving engine loads that generation and answers a
+        request, and ONE Chrome trace holds correlated spans from both
+        planes (the publish span and the serving plane agree on the
+        generation number; the request's correlation id crosses the
+        batcher pipeline)."""
+        from gan_deeplearning4j_tpu.harness import (
+            ExperimentConfig,
+            GanExperiment,
+        )
+        from gan_deeplearning4j_tpu.resilience import (
+            SupervisorConfig,
+            TrainingSupervisor,
+        )
+
+        TRACER.enable()
+        cfg = ExperimentConfig(
+            model_family="tabular", num_features=16, z_size=4,
+            batch_size_train=8, batch_size_pred=8,
+            height=1, width=1, channels=1,
+            save_models=False,
+            output_dir=os.path.join(str(tmp_path), "out"),
+        )
+        rng = np.random.default_rng(0)
+        feats = rng.random((16, 16), dtype=np.float32)
+        labels = np.eye(10, dtype=np.float32)[np.arange(16) % 10]
+
+        sup = TrainingSupervisor(
+            cfg, SupervisorConfig(total_steps=2, publish_every=2),
+            feats, labels,
+            store_root=os.path.join(str(tmp_path), "store"))
+        summary = sup.run()
+        assert summary["status"] == "completed"
+
+        # restore the trained state and publish a SERVING bundle as the
+        # next store generation — the artifact a live server would poll
+        exp = GanExperiment(cfg)
+        exp.load_models(directory=sup.store.latest_valid().path)
+        published = exp.publish_for_serving(store=sup.store)
+        serving_gen = published["generation"]
+        assert serving_gen is not None
+
+        engine = ServingEngine.from_bundle(
+            published["directory"], buckets=(1, 4))
+        service = InferenceService(engine, warmup="sync", max_latency=0.0)
+        try:
+            assert service.healthz()["generation"] == serving_gen
+            status, body = service.handle(
+                "POST", "/v1/sample",
+                {"data": (rng.random((1, 4)) * 2 - 1).tolist()})
+            assert status == 200 and body["status"] == "ok"
+        finally:
+            service.close()
+
+        trace = TRACER.chrome_trace({"drive": "e2e"})
+        events = trace["traceEvents"]
+        names = {e["name"] for e in events}
+        # both planes in one trace
+        assert {"resilience.step", "resilience.segment",
+                "resilience.publish"} <= names
+        assert {"serve.engine.restore", "serve.request",
+                "serve.batcher.dispatch", "serve.batcher.scatter"} <= names
+        # correlated: the serving bundle's publish span carries the SAME
+        # generation number the serving plane reports
+        publish_gens = {e["args"]["gen"] for e in events
+                        if e["name"] == "resilience.publish"}
+        assert serving_gen in publish_gens
+        restore = next(e for e in events
+                       if e["name"] == "serve.engine.restore")
+        assert restore["args"]["generation"] == serving_gen
+        # and the HTTP request's correlation id crossed the pipeline
+        request = next(e for e in events if e["name"] == "serve.request")
+        rid = request["args"]["trace_id"]
+        scatter = next(e for e in events
+                       if e["name"] == "serve.batcher.scatter")
+        assert rid in scatter["args"]["riders"]
+
+        # the trace is a valid, foldable artifact — the campaign gate
+        path = str(tmp_path / "e2e_trace.json")
+        TRACER.dump(path)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"), path],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "resilience.publish" in proc.stdout
