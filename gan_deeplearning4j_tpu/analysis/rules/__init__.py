@@ -29,6 +29,9 @@ from gan_deeplearning4j_tpu.analysis.rules.prng_flow import CrossModulePrngReuse
 from gan_deeplearning4j_tpu.analysis.rules.telemetry_fence import (
     TelemetryUnfencedTiming,
 )
+from gan_deeplearning4j_tpu.analysis.rules.engine_swap import (
+    SwapSeamUnguardedAccess,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -46,6 +49,7 @@ RULES = [
     MeshAxisMismatch(),
     CrossModulePrngReuse(),
     TelemetryUnfencedTiming(),
+    SwapSeamUnguardedAccess(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,57 @@
+"""deploy/ — zero-downtime generation reload: the train→serve loop closed.
+
+The two halves existed and pointed at each other — ``resilience/store.py``
+publishes digest-verified, versioned serving bundles and
+``serving/engine.py`` restores from them — but a running server never
+noticed a newer generation. This package is the control plane between
+them, the "model updates while millions of users are connected" story
+(ROADMAP; the continuous-training→live-serving shape of the
+TensorFlow-system paper in PAPERS.md):
+
+- :mod:`.watcher` — polls the checkpoint store ledger (or a bare
+  ``serving.json`` bundle directory) for a newer digest-valid serving
+  generation, quarantining corrupt generations through the store's
+  existing machinery and skipping them;
+- :mod:`.canary` — a quality gate between "the bytes verify" and "this
+  model serves": the same FID/classifier-accuracy probe
+  ``scripts/quality_run.py`` uses (imported, not shelled out), run on a
+  fixed seeded batch, thresholds RELATIVE to the incumbent; a failing
+  generation is quarantined and never served;
+- :mod:`.reloader` — constructs the candidate engine off-thread, AOT-warms
+  it against the live engine's bucket ladder and replica set, then
+  atomically swaps engines under the batcher: in-flight flights finalize
+  on the old engine, new flushes dispatch on the new one, zero requests
+  shed or lost during the swap; the old engine is retired after its last
+  flight. Candidate state, swap count, and the active generation export
+  through the telemetry registry, ``/healthz``, and ``POST /admin/reload``.
+
+The training side feeds this plane via the supervisor's serve-publish
+cadence (``python -m gan_deeplearning4j_tpu.resilience --serve-store``),
+and ``scripts/reload_drill.py`` proves the whole loop against real
+subprocesses. Architecture notes: docs/DEPLOY.md.
+"""
+
+from gan_deeplearning4j_tpu.deploy.canary import (
+    CanaryDecision,
+    CanaryGate,
+    CanaryThresholds,
+    load_quality_probe,
+)
+from gan_deeplearning4j_tpu.deploy.reloader import (
+    ReloadBusy,
+    ReloadController,
+    STATES,
+)
+from gan_deeplearning4j_tpu.deploy.watcher import BundleCandidate, StoreWatcher
+
+__all__ = [
+    "BundleCandidate",
+    "CanaryDecision",
+    "CanaryGate",
+    "CanaryThresholds",
+    "ReloadBusy",
+    "ReloadController",
+    "STATES",
+    "StoreWatcher",
+    "load_quality_probe",
+]

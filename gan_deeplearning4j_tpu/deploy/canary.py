@@ -1,0 +1,184 @@
+"""Canary gate — a candidate engine must prove quality before it serves.
+
+Digest verification (the store) proves a bundle holds exactly the bytes
+its writer produced; it says nothing about whether those bytes are a good
+model. A training run can publish a collapsed generator or a corrupted-by-
+construction state with perfectly valid digests. The canary gate closes
+that hole: before the reloader swaps a candidate in, it runs the SAME
+quality probe ``scripts/quality_run.py`` uses (imported, not shelled out —
+one definition of "quality" across the quality run and the reload plane)
+on a fixed seeded batch against both the candidate and the incumbent, and
+admits the candidate only when its numbers hold up *relative to the
+incumbent*:
+
+- **FID probe** — Fréchet distance between the candidate's seeded sample
+  batch and the real rows (raw-row features by default; pass
+  ``feature_fn`` — e.g. ``eval.fid.frozen_feature_fn`` — for image-family
+  bundles). Gate: ``candidate_fid <= incumbent_fid × fid_ratio_max +
+  fid_slack`` (the additive slack keeps near-zero incumbents from making
+  the ratio test vacuous-strict).
+- **classifier accuracy** — the frozen-feature transfer classifier scored
+  on labeled real rows. Gate: ``candidate_acc >= incumbent_acc -
+  accuracy_drop_max``. Skipped when the bundle serves no classifier or no
+  labels were provided.
+
+Thresholds are RELATIVE by design: an absolute FID bar would need
+re-tuning per dataset/model family, but "not dramatically worse than what
+is serving right now" transfers. The incumbent's probe is cached per
+(engine, generation) so steady-state reloads pay one candidate probe each.
+
+A failing candidate is never served; the reloader quarantines its
+generation through the store's existing machinery (docs/DEPLOY.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+_probe_fn = None  # the lazily imported scripts/quality_run.quality_probe
+
+
+def load_quality_probe() -> Callable:
+    """Import ``quality_probe`` from ``scripts/quality_run.py`` (the repo
+    scripts directory is not a package, so this goes through importlib).
+    One definition of the probe — the quality run CLI and this gate can
+    never disagree about what the numbers mean."""
+    global _probe_fn
+    if _probe_fn is not None:
+        return _probe_fn
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(root, "scripts", "quality_run.py")
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"cannot locate scripts/quality_run.py (looked at {path}) — "
+            f"the canary gate needs its quality_probe")
+    spec = importlib.util.spec_from_file_location("_gdt_quality_run", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    _probe_fn = module.quality_probe
+    return _probe_fn
+
+
+@dataclasses.dataclass(frozen=True)
+class CanaryThresholds:
+    """Relative quality bars (see module docstring for semantics)."""
+
+    fid_ratio_max: float = 1.5
+    fid_slack: float = 10.0
+    accuracy_drop_max: float = 0.05
+
+
+@dataclasses.dataclass
+class CanaryDecision:
+    """Outcome of one gate evaluation, with both probes for the record."""
+
+    passed: bool
+    reason: str
+    candidate: dict
+    incumbent: dict
+
+
+class CanaryGate:
+    """Probes engines with a fixed seeded batch and compares candidate
+    against incumbent under :class:`CanaryThresholds`.
+
+    ``features``/``labels`` are the real evaluation rows (labels optional
+    — accuracy is then skipped). ``probe`` is injectable for tests: any
+    ``engine -> {"fid": float, "accuracy": float|None}`` callable; the
+    default wraps ``scripts/quality_run.quality_probe``."""
+
+    def __init__(self, features, labels=None, *, num_samples: int = 256,
+                 seed: int = 666, feature_fn=None,
+                 thresholds: Optional[CanaryThresholds] = None,
+                 probe: Optional[Callable] = None):
+        self.features = np.asarray(features, dtype=np.float32)
+        if self.features.ndim != 2 or self.features.shape[0] < 2:
+            raise ValueError(
+                f"canary needs (n >= 2, d) real rows, got "
+                f"{self.features.shape}")
+        self.labels = None if labels is None else np.asarray(labels)
+        self.num_samples = int(num_samples)
+        if self.num_samples < 2:
+            raise ValueError("num_samples must be >= 2 (covariance fit)")
+        self.seed = seed
+        self.feature_fn = feature_fn
+        self.thresholds = thresholds or CanaryThresholds()
+        self._probe = probe
+        # incumbent probe cache: (engine ref, generation) -> probe dict —
+        # the strong ref pins the engine so an id() can never be recycled
+        self._incumbent_cache = None
+
+    # -- probing --------------------------------------------------------
+    def probe(self, engine) -> dict:
+        """One deterministic quality probe of ``engine`` (seeded z batch
+        through ``run("sample")``, labeled rows through
+        ``run("classify")`` when available)."""
+        if self._probe is not None:
+            return self._probe(engine)
+        quality_probe = load_quality_probe()
+        classify_fn = None
+        if "classify" in engine.kinds and self.labels is not None:
+            classify_fn = lambda rows: engine.run("classify", rows)  # noqa: E731
+        return quality_probe(
+            lambda z: engine.run("sample", z),
+            self.features,
+            z_size=engine.input_width("sample"),
+            num_samples=self.num_samples,
+            seed=self.seed,
+            classify_fn=classify_fn,
+            labels=self.labels,
+            feature_fn=self.feature_fn,
+        )
+
+    def _incumbent_probe(self, incumbent) -> dict:
+        key = (incumbent, getattr(incumbent, "generation", None))
+        if (self._incumbent_cache is not None
+                and self._incumbent_cache[0] == key):
+            return self._incumbent_cache[1]
+        result = self.probe(incumbent)
+        self._incumbent_cache = (key, result)
+        return result
+
+    # -- the gate --------------------------------------------------------
+    def evaluate(self, candidate, incumbent) -> CanaryDecision:
+        """Admit or reject ``candidate`` relative to ``incumbent``."""
+        inc = self._incumbent_probe(incumbent)
+        cand = self.probe(candidate)
+        t = self.thresholds
+        failures = []
+        fid_limit = inc["fid"] * t.fid_ratio_max + t.fid_slack
+        # written as not-<= so a NaN probe (degenerate samples) fails the
+        # gate instead of slipping past a > comparison
+        if not (cand["fid"] <= fid_limit):
+            failures.append(
+                f"fid {cand['fid']:.4g} exceeds limit {fid_limit:.4g} "
+                f"(incumbent {inc['fid']:.4g} × {t.fid_ratio_max} + "
+                f"{t.fid_slack})")
+        if (cand.get("accuracy") is not None
+                and inc.get("accuracy") is not None):
+            floor = inc["accuracy"] - t.accuracy_drop_max
+            if not (cand["accuracy"] >= floor):
+                failures.append(
+                    f"accuracy {cand['accuracy']:.4f} below floor "
+                    f"{floor:.4f} (incumbent {inc['accuracy']:.4f} - "
+                    f"{t.accuracy_drop_max})")
+        if not failures:
+            # the admitted candidate is about to BECOME the incumbent:
+            # roll the cache forward so the next reload reuses its probe
+            # (one candidate probe per reload) and the retired engine's
+            # strong reference — params, executables, staging pools — is
+            # released instead of pinned until the next evaluate
+            self._incumbent_cache = (
+                (candidate, getattr(candidate, "generation", None)), cand)
+        return CanaryDecision(
+            passed=not failures,
+            reason="; ".join(failures) if failures else "ok",
+            candidate=cand,
+            incumbent=inc,
+        )

@@ -38,6 +38,14 @@ def main(argv=None) -> int:
                    help="npz with 'features' and 'labels' arrays")
     p.add_argument("--total-steps", type=int, required=True)
     p.add_argument("--publish-every", type=int, default=10)
+    p.add_argument("--serve-store", default=None, metavar="DIR",
+                   help="also publish inference bundles (generator + "
+                        "classifier, no updater) into this checkpoint "
+                        "store on a cadence — what a live server's reload "
+                        "plane watches (docs/DEPLOY.md)")
+    p.add_argument("--serve-publish-every", type=int, default=0,
+                   help="serving-bundle cadence in steps (0 = follow "
+                        "--publish-every; needs --serve-store)")
     p.add_argument("--max-retries", type=int, default=3)
     p.add_argument("--backoff-base", type=float, default=0.5)
     p.add_argument("--backoff-max", type=float, default=30.0)
@@ -98,10 +106,12 @@ def main(argv=None) -> int:
             backoff_max_s=args.backoff_max,
             keep_last=args.keep_last,
             keep_every=args.keep_every,
+            serve_publish_every=args.serve_publish_every,
         ),
         features, labels,
         store_root=args.store,
         faults=faults,
+        serve_store_root=args.serve_store,
     )
     sup.install_signal_handlers()
 

@@ -244,6 +244,16 @@ class CheckpointStore:
     def quarantined(self) -> List[int]:
         return self._scan(self.quarantine_dir)
 
+    def generations_newer_than(self, number: Optional[int]) -> List[int]:
+        """Published generation numbers strictly newer than ``number``
+        (ascending; all of them when ``number`` is None) — the reload
+        plane's ledger lookup: a watcher tracking the served generation
+        asks only for what it has not seen yet."""
+        published = self.published()
+        if number is None:
+            return published
+        return [n for n in published if n > number]
+
     def next_number(self) -> int:
         """Monotonic across GC and quarantine: one more than anything the
         directories or the ledger have ever seen."""

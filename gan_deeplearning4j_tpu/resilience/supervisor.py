@@ -75,6 +75,8 @@ class SupervisorConfig:
     backoff_max_s: float = 30.0
     keep_last: int = 3             # store retention: newest K generations
     keep_every: int = 0            # plus every N-th generation (0 = off)
+    serve_publish_every: int = 0   # serving-bundle cadence when a serve
+    # store is wired (deploy/ reload plane); 0 = follow publish_every
 
     def validate(self) -> "SupervisorConfig":
         if self.total_steps < 1:
@@ -85,6 +87,9 @@ class SupervisorConfig:
             raise ValueError("max_retries must be >= 0")
         if self.backoff_base_s < 0 or self.backoff_max_s < self.backoff_base_s:
             raise ValueError("need 0 <= backoff_base_s <= backoff_max_s")
+        if self.serve_publish_every < 0:
+            raise ValueError("serve_publish_every must be >= 0 (0 = follow "
+                             "publish_every)")
         return self
 
 
@@ -101,7 +106,9 @@ class TrainingSupervisor:
                  store_root: Optional[str] = None,
                  faults=None,
                  sleep: Callable[[float], None] = time.sleep,
-                 experiment_factory=None) -> None:
+                 experiment_factory=None,
+                 serve_store: Optional[CheckpointStore] = None,
+                 serve_store_root: Optional[str] = None) -> None:
         self.exp_config = exp_config
         self.sup = sup_config.validate()
         self.features = np.asarray(features)
@@ -119,6 +126,17 @@ class TrainingSupervisor:
                                     keep_every=self.sup.keep_every,
                                     fault_injector=faults)
         self.store = store
+        # the OTHER store: inference bundles for a live server's reload
+        # plane (deploy/). Kept separate from the training store so
+        # checkpoint retention and bundle retention never fight, and a
+        # serving watcher never scans past training generations.
+        if serve_store is None and serve_store_root is not None:
+            serve_store = CheckpointStore(
+                serve_store_root, keep_last=self.sup.keep_last,
+                keep_every=self.sup.keep_every)
+        self.serve_store = serve_store
+        self._serve_every = (self.sup.serve_publish_every
+                             or self.sup.publish_every)
         self.faults = faults
         self._sleep = sleep
         if experiment_factory is None:
@@ -138,6 +156,9 @@ class TrainingSupervisor:
             "resilience_restores_total", "restores from a store generation")
         self._c_faults = registry.counter(
             "resilience_faults_total", "trapped worker faults (retried)")
+        self._c_serve_publishes = registry.counter(
+            "resilience_serve_publishes_total",
+            "serving bundles published for the reload plane")
 
     # -- preemption -----------------------------------------------------
     def request_preemption(self) -> None:
@@ -195,6 +216,22 @@ class TrainingSupervisor:
             self.faults.on_published(self.store, generation)
         return {"generation": generation.number, "seconds": seconds,
                 "digests": digests}
+
+    def _publish_serving(self, exp) -> dict:
+        """Publish the inference bundle (generator + classifier, no
+        updater state) as a digest-verified generation of the SERVE
+        store — what a live server's reload plane (deploy/) watches. Pure
+        observation of the current state: training is unaffected, and the
+        bit-exact-resume contract never depends on these bundles."""
+        t0 = time.perf_counter()
+        info = exp.publish_for_serving(store=self.serve_store)
+        seconds = time.perf_counter() - t0
+        self._c_serve_publishes.inc()
+        self.events.append({
+            "event": "serve_publish", "generation": info.get("generation"),
+            "step": exp.batch_counter, "seconds": seconds,
+        })
+        return info
 
     # -- the loop ---------------------------------------------------------
     def run(self) -> dict:
@@ -282,6 +319,19 @@ class TrainingSupervisor:
             last_publish_step = exp.batch_counter
             final_publish = info
 
+        # serve-bundle cadence (deploy/ reload plane), attempt-local dedup
+        # like the checkpoint cadence above
+        serve = {"count": 0, "generation": None, "last_step": -1}
+
+        def serve_publish() -> None:
+            if (self.serve_store is None
+                    or exp.batch_counter == serve["last_step"]):
+                return
+            info = self._publish_serving(exp)
+            serve["count"] += 1
+            serve["generation"] = info.get("generation")
+            serve["last_step"] = exp.batch_counter
+
         t_segment = time.perf_counter()
 
         def segment_span(status: str) -> None:
@@ -293,11 +343,13 @@ class TrainingSupervisor:
         while exp.batch_counter < self.sup.total_steps:
             if self._preempt:
                 publish()
+                serve_publish()  # a preempted trainer leaves its newest
+                # weights for the fleet, not just for its own resume
                 segment_span("preempted")
                 return self._summary(
                     "preempted", exp, attempt, start_step, restore_s,
                     first_step_s, train_s, publish_s, publish_count,
-                    final_publish)
+                    final_publish, serve)
             if self.faults is not None:
                 self.faults.on_step(exp.batch_counter)
             feats, labels = self.batch_at(exp.batch_counter)
@@ -315,15 +367,18 @@ class TrainingSupervisor:
             exp.batch_counter += 1
             if exp.batch_counter % self.sup.publish_every == 0:
                 publish()
+            if exp.batch_counter % self._serve_every == 0:
+                serve_publish()
         publish()  # final state, even off-cadence
+        serve_publish()  # the live fleet converges to the final weights
         segment_span("completed")
         return self._summary("completed", exp, attempt, start_step,
                              restore_s, first_step_s, train_s, publish_s,
-                             publish_count, final_publish)
+                             publish_count, final_publish, serve)
 
     def _summary(self, status, exp, attempt, start_step, restore_s,
                  first_step_s, train_s, publish_s, publish_count,
-                 final_publish) -> dict:
+                 final_publish, serve=None) -> dict:
         return {
             "status": status,
             "steps": exp.batch_counter,
@@ -338,5 +393,7 @@ class TrainingSupervisor:
             "publish_count": publish_count,
             "final_generation": (final_publish or {}).get("generation"),
             "state_digests": (final_publish or {}).get("digests"),
+            "serve_publish_count": (serve or {}).get("count", 0),
+            "final_serve_generation": (serve or {}).get("generation"),
             "events": list(self.events),
         }

@@ -17,9 +17,12 @@ features) through one dynamic micro-batcher:
 - :mod:`.batcher` — a queue-based micro-batcher with max-latency / max-batch
   triggers, continuous-batching scheduling (hold for fullness while the
   device is busy), a bounded two-stage dispatch/completion pipeline that
-  overlaps host assembly with device execution, per-request deadlines, and
+  overlaps host assembly with device execution, per-request deadlines,
   backpressure (bounded queue that sheds with an explicit "overloaded"
-  result instead of growing without bound);
+  result instead of growing without bound), and the zero-downtime
+  engine-swap seam the reload plane (``deploy/``, docs/DEPLOY.md) drives:
+  ``swap_engine`` reroutes future flushes atomically while in-flight
+  flights finalize on the engine that dispatched them;
 - :mod:`.service` — the in-process API plus a stdlib-only HTTP JSON
   endpoint with ``/healthz`` and ``/metrics`` (JSON or ``?format=prom``
   Prometheus text), the served bundle's ``generation``, and the telemetry

@@ -69,6 +69,32 @@ def main(argv=None) -> int:
                    help="persistent XLA compile cache dir so process "
                         "restarts reuse AOT artifacts (default: "
                         "$GDT_COMPILATION_CACHE / repo .jax_cache policy)")
+    p.add_argument("--reload-store", default=None, metavar="DIR",
+                   help="zero-downtime reload plane (docs/DEPLOY.md): "
+                        "watch this checkpoint-store root for newer "
+                        "digest-valid serving generations and swap them in "
+                        "live; without --bundle/--generator the FIRST "
+                        "valid generation there is the initial model")
+    p.add_argument("--reload-poll", type=float, default=2.0,
+                   help="reload-plane poll interval in seconds")
+    p.add_argument("--reload-wait", type=float, default=120.0,
+                   help="with --reload-store and no --bundle: seconds to "
+                        "wait for the first valid serving generation")
+    p.add_argument("--canary-data", default=None, metavar="NPZ",
+                   help="npz with 'features' (and optionally 'labels') "
+                        "arrays for the reload canary gate; omitted = no "
+                        "quality gate (digest verification still applies)")
+    p.add_argument("--canary-samples", type=int, default=256,
+                   help="seeded probe batch size for the canary gate")
+    p.add_argument("--canary-fid-ratio", type=float, default=1.5,
+                   help="reject a candidate whose probe FID exceeds "
+                        "incumbent × ratio + slack")
+    p.add_argument("--canary-fid-slack", type=float, default=10.0,
+                   help="additive FID slack (keeps near-zero incumbents "
+                        "from making the ratio test vacuous-strict)")
+    p.add_argument("--canary-acc-drop", type=float, default=0.05,
+                   help="reject a candidate whose classifier accuracy "
+                        "drops more than this below the incumbent")
     p.add_argument("--telemetry", action="store_true",
                    help="enable span tracing (GET /debug/spans exports a "
                         "Chrome trace; also honored via "
@@ -94,6 +120,12 @@ def main(argv=None) -> int:
     if cache_dir:
         logging.getLogger(__name__).info("compilation cache: %s", cache_dir)
     replicas = None if args.replicas == "all" else int(args.replicas)
+    watcher = None
+    if args.reload_store is not None:
+        from gan_deeplearning4j_tpu.deploy import StoreWatcher
+        from gan_deeplearning4j_tpu.resilience import CheckpointStore
+
+        watcher = StoreWatcher(store=CheckpointStore(args.reload_store))
     if args.bundle is not None:
         engine = ServingEngine.from_bundle(
             args.bundle, buckets=args.buckets, replicas=replicas
@@ -106,8 +138,31 @@ def main(argv=None) -> int:
             feature_vertex=args.feature_vertex,
             replicas=replicas,
         )
+    elif watcher is not None:
+        # bootstrap from the watched store: the first valid serving
+        # generation is the initial model (a trainer may still be warming
+        # up toward its first publish — wait, bounded)
+        import time as _time
+
+        log = logging.getLogger(__name__)
+        deadline = _time.monotonic() + args.reload_wait
+        candidate = None
+        while candidate is None:
+            candidate = watcher.poll_once()
+            if candidate is None:
+                if _time.monotonic() >= deadline:
+                    log.error("no valid serving generation appeared in %s "
+                              "within %.0fs", args.reload_store,
+                              args.reload_wait)
+                    return 1
+                _time.sleep(0.5)
+        log.info("initial bundle: generation %s (%s)",
+                 candidate.generation, candidate.path)
+        engine = ServingEngine.from_bundle(
+            candidate.path, buckets=args.buckets, replicas=replicas
+        )
     else:
-        p.error("need --bundle or --generator/--classifier")
+        p.error("need --bundle, --generator/--classifier, or --reload-store")
         return 2  # unreachable; argparse exits
     service = InferenceService(
         engine,
@@ -118,7 +173,37 @@ def main(argv=None) -> int:
         pipeline_depth=args.pipeline_depth,
         artifacts_dir=args.debug_artifacts,
     )
-    serve_forever(service, args.host, args.port)
+    controller = None
+    if watcher is not None:
+        from gan_deeplearning4j_tpu.deploy import CanaryGate, CanaryThresholds
+        from gan_deeplearning4j_tpu.deploy import ReloadController
+        import numpy as np
+
+        canary = None
+        if args.canary_data:
+            with np.load(args.canary_data) as npz:
+                features = npz["features"]
+                labels = npz["labels"] if "labels" in npz.files else None
+            canary = CanaryGate(
+                features, labels,
+                num_samples=min(args.canary_samples, features.shape[0]),
+                thresholds=CanaryThresholds(
+                    fid_ratio_max=args.canary_fid_ratio,
+                    fid_slack=args.canary_fid_slack,
+                    accuracy_drop_max=args.canary_acc_drop,
+                ),
+            )
+        controller = ReloadController(
+            service, watcher, canary=canary,
+            poll_interval=args.reload_poll,
+        )
+        service.attach_reloader(controller)
+        controller.start()
+    try:
+        serve_forever(service, args.host, args.port)
+    finally:
+        if controller is not None:
+            controller.stop()
     return 0
 
 

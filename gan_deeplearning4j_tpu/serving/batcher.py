@@ -35,6 +35,14 @@ synchronous ``(kind, rows) -> rows`` callable (unit tests use fakes), or
 ``finalize(handle)`` pair (``ServingEngine``, or a fake in the pipelining
 tests).
 
+Engine-mode batchers additionally expose the ZERO-DOWNTIME SWAP SEAM the
+reload plane (``deploy/``, docs/DEPLOY.md) drives: :meth:`swap_engine`
+atomically reroutes future flushes under the batcher lock, every cut
+flush carries its dispatching engine on the flight record (in-flight work
+finalizes on the OLD engine), and :meth:`flights_on` is the retirement
+signal. All access to the swappable engine attribute goes through the
+lock — jaxlint JG016 polices the seam.
+
 Observability (docs/OBSERVABILITY.md): counters/gauges and THE latency
 histogram live in the process-wide telemetry registry (the per-instance
 ints remain for the instance-scoped ``metrics()`` JSON), and with tracing
@@ -128,15 +136,23 @@ class _Pending:
 
 
 class _Inflight:
-    """One dispatched flush traveling from worker to completer."""
+    """One dispatched flush traveling from worker to completer.
 
-    __slots__ = ("riders", "handle", "total_rows", "flight_id")
+    ``engine`` is the engine that DISPATCHED this flush, pinned at cut
+    time: after :meth:`MicroBatcher.swap_engine` an in-flight handle must
+    finalize on the engine whose staging buffers and replica ledger it
+    holds — finalizing it on the new engine would recycle foreign buffers
+    and release phantom in-flight reservations."""
 
-    def __init__(self, riders, handle, total_rows, flight_id=None):
+    __slots__ = ("riders", "handle", "total_rows", "flight_id", "engine")
+
+    def __init__(self, riders, handle, total_rows, flight_id=None,
+                 engine=None):
         self.riders = riders
         self.handle = handle
         self.total_rows = total_rows
         self.flight_id = flight_id  # async-span id; None while tracing is off
+        self.engine = engine  # dispatching engine; None in run_fn mode
 
 
 class MicroBatcher:
@@ -191,6 +207,12 @@ class MicroBatcher:
         self._window_used = 0  # cut-or-dispatched flushes not yet completed
         self._closed = False
         self._worker_done = False
+        self._swaps = 0
+        # the flush the worker/completer is currently working OUTSIDE the
+        # lock, attributed to its engine — with the _inflight queue these
+        # make flights_on() exact, which is what engine retirement waits on
+        self._dispatching_on = None
+        self._finalizing_on = None
 
         # -- counters (read under the lock; exported by metrics()) ----------
         self._submitted: Dict[str, int] = defaultdict(int)
@@ -222,6 +244,9 @@ class MicroBatcher:
         ))
         self._c_flushes = registry.counter(
             "serve_flushes_total", "device flushes cut by the batcher")
+        self._c_swaps = registry.counter(
+            "serve_engine_swaps_total",
+            "zero-downtime engine swaps performed by the batcher")
         self._c_flush_rows = registry.histogram(
             "serve_flush_rows", "rows per flush (batch occupancy)",
             max_samples=max_samples,
@@ -306,6 +331,50 @@ class MicroBatcher:
             self._cv.notify_all()
         self._worker.join(timeout=10.0)
         self._completer.join(timeout=10.0)
+
+    # -- the engine-swap seam (deploy/ reload plane) ------------------------
+    @property
+    def engine(self):
+        """The engine NEW flushes dispatch on (None in run_fn mode). This
+        lock-guarded accessor — and :meth:`swap_engine` — are the only
+        places the swappable attribute may be touched (jaxlint JG016
+        polices unguarded reads)."""
+        with self._lock:
+            return self._engine
+
+    def swap_engine(self, engine):
+        """Atomically route all FUTURE flushes to ``engine``; returns the
+        previous engine. Zero-downtime by construction: flushes already
+        cut or in flight carry their dispatching engine on the
+        :class:`_Inflight` record and finalize on it, new cuts snapshot
+        the new engine under the same lock that cuts the batch, and
+        nothing is shed or drained in between. The caller retires the old
+        engine once :meth:`flights_on` reports it drained."""
+        if engine is None:
+            raise ValueError("swap_engine needs an engine")
+        if self._run_fn is not None:
+            raise ValueError(
+                "swap_engine requires an engine-mode batcher (run_fn mode "
+                "has no engine to swap)")
+        with self._lock:
+            old, self._engine = self._engine, engine
+            self._swaps += 1
+            self._cv.notify_all()
+        self._c_swaps.inc()
+        return old
+
+    def flights_on(self, engine) -> int:
+        """Flushes currently owned by ``engine`` anywhere in the pipeline:
+        queued between worker and completer, being dispatched, or being
+        finalized. Zero means the engine's last flight has fully drained —
+        the retirement condition after a swap."""
+        with self._lock:
+            n = sum(1 for ent in self._inflight if ent.engine is engine)
+            if self._dispatching_on is engine:
+                n += 1
+            if self._finalizing_on is engine:
+                n += 1
+            return n
 
     # -- worker side --------------------------------------------------------
     def _take_batch(self):
@@ -420,18 +489,20 @@ class MicroBatcher:
             self._window_used -= 1
             self._cv.notify_all()
 
-    def _dispatch(self, kind: str, rows_list):
-        """Stage-A half of one flush. For an async engine this stages,
-        transfers, and launches without waiting; for a plain run_fn the
-        handle defers ALL work to finalize (stage B), keeping the worker
-        free to keep cutting batches."""
-        if self._engine is not None:
-            return self._engine.dispatch(kind, rows_list)
+    def _dispatch(self, engine, kind: str, rows_list):
+        """Stage-A half of one flush, on the engine snapshotted AT CUT
+        TIME (the swap seam: the live attribute is only read under the
+        lock). For an async engine this stages, transfers, and launches
+        without waiting; for a plain run_fn the handle defers ALL work to
+        finalize (stage B), keeping the worker free to keep cutting
+        batches."""
+        if engine is not None:
+            return engine.dispatch(kind, rows_list)
         return (kind, rows_list)
 
-    def _finalize(self, handle) -> np.ndarray:
-        if self._engine is not None:
-            return np.asarray(self._engine.finalize(handle))
+    def _finalize(self, engine, handle) -> np.ndarray:
+        if engine is not None:
+            return np.asarray(engine.finalize(handle))
         kind, rows_list = handle
         # the concatenate stays INSIDE the stage-B guard: a width-mismatched
         # rider must error its own batch, not kill the completer thread
@@ -443,6 +514,12 @@ class MicroBatcher:
             while True:
                 with self._lock:
                     batch = self._take_batch()
+                    # snapshot the engine in the SAME critical section that
+                    # cut the batch: a swap is atomic with respect to cuts,
+                    # so every flush belongs to exactly one engine
+                    engine = self._engine
+                    if batch is not None:
+                        self._dispatching_on = engine
                 if batch is None:
                     return
                 now = time.monotonic()
@@ -458,6 +535,8 @@ class MicroBatcher:
                     else:
                         live.append(req)
                 if not live:
+                    with self._lock:
+                        self._dispatching_on = None
                     self._release_slot()
                     continue
                 flight_id = None
@@ -470,11 +549,12 @@ class MicroBatcher:
                 t0 = time.perf_counter()
                 try:
                     handle = self._dispatch(
-                        live[0].kind, [r.rows for r in live]
+                        engine, live[0].kind, [r.rows for r in live]
                     )
                 except Exception as exc:  # dispatch failure -> riders error
                     with self._lock:
                         self._errors += len(live)
+                        self._dispatching_on = None
                     for req in live:
                         self._c_request["error"](req.kind).inc()
                         req.finish(ServeResult(
@@ -493,7 +573,8 @@ class MicroBatcher:
                 with self._lock:
                     self._stages.add("assemble", time.perf_counter() - t0)
                     self._inflight.append(
-                        _Inflight(live, handle, total, flight_id))
+                        _Inflight(live, handle, total, flight_id, engine))
+                    self._dispatching_on = None
                     self._cv.notify_all()
         finally:
             with self._lock:
@@ -508,15 +589,19 @@ class MicroBatcher:
                 if not self._inflight:
                     return  # worker exited and everything is finalized
                 ent = self._inflight.popleft()
+                self._finalizing_on = ent.engine
             t0 = time.perf_counter()
             try:
-                out = self._finalize(ent.handle)
+                # finalize on the engine that DISPATCHED this flush — after
+                # a swap the old engine's in-flight work still lands here
+                out = self._finalize(ent.engine, ent.handle)
             except Exception as exc:  # engine failure -> every rider errors
                 if ent.flight_id is not None:
                     TRACER.async_end("serve.flight", ent.flight_id,
                                      {"status": "error"})
                 with self._lock:
                     self._errors += len(ent.riders)
+                    self._finalizing_on = None
                 for req in ent.riders:
                     self._c_request["error"](req.kind).inc()
                     req.finish(ServeResult(
@@ -541,6 +626,7 @@ class MicroBatcher:
                 TRACER.async_end("serve.flight", ent.flight_id,
                                  {"status": "ok"})
             with self._lock:
+                self._finalizing_on = None
                 self._stages.add("device", t1 - t0)
                 self._stages.add("complete", t2 - t1)
                 self._flushes += 1
@@ -578,6 +664,7 @@ class MicroBatcher:
                 "shed_deadline": self._shed_deadline,
                 "errors": self._errors,
                 "flushes": self._flushes,
+                "engine_swaps": self._swaps,
                 "queue_depth": len(self._queue),
                 "batch_occupancy": {str(k): v for k, v in sorted(self._occupancy.items())},
                 "latency_ms": lat,

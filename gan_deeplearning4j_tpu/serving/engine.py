@@ -116,6 +116,7 @@ class ServingEngine:
         feature_vertex: Optional[str] = None,
         replicas: Optional[int] = 1,
         generation: Optional[int] = None,
+        export_gauge: bool = True,
     ):
         import jax
 
@@ -217,7 +218,13 @@ class ServingEngine:
             "serving_generation",
             "store generation of the served bundle (-1 = unversioned)",
         )
-        self._g_generation.set(-1 if generation is None else generation)
+        # a reload-plane CANDIDATE engine is constructed (and warmed, and
+        # canaried) while another engine is still live — it must not
+        # claim the process-wide gauge until it actually serves
+        # (export_gauge=False; the reloader calls export_generation()
+        # at the swap)
+        if export_gauge:
+            self.export_generation()
         self._staging: Dict[Tuple[str, int], List[_StagingBuf]] = {}
         self._outstanding = [0] * replicas  # in-flight flushes per replica
         self._dispatches = [0] * replicas
@@ -242,6 +249,7 @@ class ServingEngine:
         feature_vertex: Optional[str] = None,
         replicas: Optional[int] = 1,
         generation: Optional[int] = None,
+        export_gauge: bool = True,
     ) -> "ServingEngine":
         """Restore from serializer checkpoint zips. Updater state is never
         loaded — a serving replica has no optimizer."""
@@ -256,12 +264,13 @@ class ServingEngine:
                 graph, params, _, _ = read_model(path, load_updater=False)
                 models[role] = (graph, params)
         return cls(models, buckets=buckets, feature_vertex=feature_vertex,
-                   replicas=replicas, generation=generation)
+                   replicas=replicas, generation=generation,
+                   export_gauge=export_gauge)
 
     @classmethod
     def from_bundle(
         cls, directory: str, *, buckets: Sequence[int] = DEFAULT_BUCKETS,
-        replicas: Optional[int] = 1,
+        replicas: Optional[int] = 1, export_gauge: bool = True,
     ) -> "ServingEngine":
         """Load a ``serving.json`` bundle published by
         ``GanExperiment.publish_for_serving``."""
@@ -284,9 +293,26 @@ class ServingEngine:
             feature_vertex=manifest.get("feature_vertex"),
             replicas=replicas,
             generation=manifest.get("generation"),
+            export_gauge=export_gauge,
         )
 
     # -- introspection ------------------------------------------------------
+    def export_generation(self) -> None:
+        """Publish this engine's bundle generation to the process-wide
+        ``serving_generation`` gauge — the moment an engine becomes THE
+        served engine (construction by default; the reload plane defers it
+        to the swap so a warming candidate never claims the gauge)."""
+        self._g_generation.set(-1 if self.generation is None
+                               else self.generation)
+
+    @property
+    def in_flight(self) -> int:
+        """Dispatched-but-unfinalized flushes across every replica — the
+        reload plane's retirement signal (an old engine is retired once
+        its last flight drains to zero)."""
+        with self._lock:
+            return sum(self._outstanding)
+
     @property
     def kinds(self) -> Tuple[str, ...]:
         return tuple(self._kinds)

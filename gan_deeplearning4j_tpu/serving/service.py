@@ -16,6 +16,9 @@ framework dependency, per the repo's no-new-deps rule). Endpoints:
 - ``POST /debug/trace?ms=N``  on-demand ``jax.profiler`` device capture
   into the service's artifacts dir — 202 + the artifact path (async;
   ``block=1`` waits for 200), 409 while one is running
+- ``POST /admin/reload``  force an immediate reload-plane poll (202;
+  ``block=1`` waits for the cycle and answers 200; 409 while a reload is
+  in progress or when no reload plane is attached — docs/DEPLOY.md)
 - ``GET  /debug/spans``  the span tracer's Chrome trace JSON (Perfetto-
   loadable; empty unless tracing is enabled)
 
@@ -72,10 +75,12 @@ class InferenceService:
         pipeline_depth: Optional[int] = None,
         artifacts_dir: Optional[str] = None,
     ):
-        self.engine = engine
         # where POST /debug/trace dumps device captures (resolved lazily so
         # constructing a service never touches the filesystem)
         self.artifacts_dir = artifacts_dir
+        # the reload control plane (deploy.ReloadController), when attached:
+        # owns POST /admin/reload and the /healthz "reload" block
+        self.reloader = None
         if warmup in (True, "sync"):
             engine.warmup()
         elif warmup in ("eager", "background"):
@@ -91,6 +96,18 @@ class InferenceService:
             pipeline_depth=pipeline_depth,
         )
 
+    @property
+    def engine(self) -> ServingEngine:
+        """The engine CURRENTLY serving — resolved through the batcher's
+        lock-guarded swap seam, so after a zero-downtime reload every
+        surface (healthz, metrics, routing) reflects the new engine."""
+        return self.batcher.engine
+
+    def attach_reloader(self, controller) -> None:
+        """Wire a ``deploy.ReloadController``: enables POST /admin/reload
+        and the /healthz candidate-state block."""
+        self.reloader = controller
+
     # -- typed convenience wrappers ----------------------------------------
     def sample(self, z, timeout: Optional[float] = None) -> ServeResult:
         return self.batcher.submit("sample", z, timeout=timeout)
@@ -103,9 +120,10 @@ class InferenceService:
 
     # -- shared request handler --------------------------------------------
     def healthz(self) -> dict:
-        if self.engine.warming:
+        engine = self.engine  # one snapshot — a swap mid-handler is benign
+        if engine.warming:
             status = "warming"
-        elif self.engine.warm_failed:
+        elif engine.warm_failed:
             # a failed background warmup must NOT look healthy: the ladder
             # is not compiled, so requests would pay serve-time compiles
             status = "error"
@@ -113,13 +131,17 @@ class InferenceService:
             status = "ok"
         body = {
             "status": status,
-            "kinds": list(self.engine.kinds),
-            "buckets": list(self.engine.buckets),
-            "replicas": self.engine.replica_count,
+            "kinds": list(engine.kinds),
+            "buckets": list(engine.buckets),
+            "replicas": engine.replica_count,
             # the version the reload plane (and any canary gate) keys on:
             # None when the engine was loaded from bare checkpoints
-            "generation": self.engine.generation,
+            "generation": engine.generation,
         }
+        if self.reloader is not None:
+            # candidate state (idle/warming/canary/swapping/rejected), swap
+            # and rejection counts — the reload plane's liveness surface
+            body["reload"] = self.reloader.status()
         if status == "error":
             body["error"] = "engine warmup failed"
         return body
@@ -128,11 +150,12 @@ class InferenceService:
         """The JSON ``/metrics`` payload — the PR 3 schema plus
         ``generation`` (a schema-compatible superset; every number now
         originates in the telemetry registry or the batcher ledger)."""
+        engine = self.engine  # one snapshot across the payload
         return {
             **self.batcher.metrics(),
-            "generation": self.engine.generation,
-            "engine": self.engine.stats(),
-            "compile_counts": self.engine.compile_counts,
+            "generation": engine.generation,
+            "engine": engine.stats(),
+            "compile_counts": engine.compile_counts,
         }
 
     def metrics_text(self) -> str:
@@ -170,6 +193,29 @@ class InferenceService:
         return 202, {"status": "accepted", "artifact": path,
                      "duration_ms": ms}
 
+    def _admin_reload(self, params: dict) -> Tuple[int, dict]:
+        """POST /admin/reload — force an immediate reload-plane poll,
+        skipping the remainder of the watcher interval. Semantics mirror
+        ``/debug/trace``: async by default (202 + current reload state —
+        a candidate warm/canary cycle can take seconds), ``block=1`` waits
+        for the triggered cycle and answers 200 with its outcome, 409 when
+        a reload cycle is already in progress (or when no reload plane is
+        attached — there is nothing to poll)."""
+        if self.reloader is None:
+            return 409, {"status": "error",
+                         "error": "no reload plane attached (start the "
+                                  "server with --reload-store)"}
+        from gan_deeplearning4j_tpu.deploy.reloader import ReloadBusy
+
+        block = params.get("block", ["0"])[0] not in ("0", "", "false")
+        try:
+            status = self.reloader.poll_now(wait=block)
+        except ReloadBusy as exc:
+            return 409, {"status": "error", "error": str(exc)}
+        if block:
+            return 200, {"status": "ok", "reload": status}
+        return 202, {"status": "accepted", "reload": status}
+
     def handle(self, method: str, path: str, payload: Optional[dict] = None
                ) -> Tuple[int, dict]:
         """(http_status, response_body) for one request — the single routing
@@ -187,9 +233,14 @@ class InferenceService:
                 {"source": "gan_deeplearning4j_tpu.serving"})
         if method == "POST" and path == "/debug/trace":
             return self._debug_trace(params)
+        if method == "POST" and path == "/admin/reload":
+            return self._admin_reload(params)
         if method == "POST" and path.startswith("/v1/"):
             kind = path[len("/v1/"):]
-            if kind not in self.engine.kinds:
+            # one engine snapshot for the whole request: a swap between the
+            # kinds check and the width check must not mix two engines
+            engine = self.engine
+            if kind not in engine.kinds:
                 return 404, {"status": "error",
                              "error": f"unknown request kind {kind!r}"}
             data = (payload or {}).get("data")
@@ -201,7 +252,7 @@ class InferenceService:
                 return 400, {"status": "error", "error": f"bad 'data': {exc}"}
             if rows.ndim == 1:
                 rows = rows[None, :]
-            width = self.engine.input_width(kind)
+            width = engine.input_width(kind)
             # reject malformed shapes HERE: a bad row must 400 its own
             # request, never reach the shared batch and error its riders
             if rows.ndim != 2 or rows.shape[0] < 1 or rows.shape[1] != width:

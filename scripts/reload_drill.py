@@ -1,0 +1,469 @@
+#!/usr/bin/env python
+"""Reload drill — a live server under load tracks a training run, swap by
+swap, and a poisoned generation is quarantined by the canary gate.
+
+The drill is the executable form of docs/DEPLOY.md's invariants, against
+real subprocesses:
+
+1. **seed bundle** — an untrained experiment publishes serving generation
+   0 into a fresh serve store (in-process; publishing is cheap).
+2. **live server** — ``python -m gan_deeplearning4j_tpu.serving
+   --reload-store`` boots from that generation, warms synchronously, and
+   starts its reload plane (watcher poll + canary gate on the workload's
+   own data). Closed-loop client threads then hammer ``/v1/sample`` for
+   the rest of the drill.
+3. **supervisor segment** — ``python -m gan_deeplearning4j_tpu.resilience
+   --serve-store`` trains the toy workload, publishing serving bundles on
+   cadence. The drill watches ``/healthz`` and requires the server to
+   swap to ≥ 2 newer generations and to converge on the trainer's FINAL
+   generation — with **zero** requests lost and **zero** shed across every
+   swap (the zero-downtime invariant).
+4. **poison** — the drill republishes the newest bundle with a saturated
+   (all-weights-large) generator: digest-VALID, quality-garbage. A forced
+   ``POST /admin/reload?block=1`` must reject it at the canary gate,
+   quarantine it through the store, and keep serving the good generation.
+5. **evidence** — the server's span trace (``GET /debug/spans``) must
+   contain a ``deploy.swap`` span, and the Prometheus
+   ``serving_generation`` gauge must equal the final good generation.
+
+Results land as a BENCH-style JSON (``--output``; ``--record TAG`` also
+writes ``BENCH_reload_<TAG>.json`` at the repo root). Exit status is
+nonzero on any invariant breach, so CI gates on the drill directly
+(``scripts/tpu_campaign.sh`` runs ``--smoke`` CPU-pinned after the
+resilience drill).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from resilience_drill import make_workload  # noqa: E402 (scripts/ sibling)
+
+SERVER = [sys.executable, "-m", "gan_deeplearning4j_tpu.serving"]
+WORKER = [sys.executable, "-m", "gan_deeplearning4j_tpu.resilience"]
+
+# Subprocesses run with the persistent XLA compilation cache OFF for the
+# same reason the resilience drill's workers do (XLA:CPU AOT loader
+# hazard — see resilience_drill.run_worker): a cache-poisoned segfault
+# must not masquerade as a reload failure.
+_ENV = {**os.environ, "GDT_COMPILATION_CACHE": "off"}
+
+
+def log(msg: str) -> None:
+    print(f"[reload-drill] {msg}", flush=True)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http_json(method: str, url: str, payload=None, timeout: float = 10.0):
+    """(status, decoded JSON body) — None body on connection failure."""
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            return exc.code, json.loads(exc.read())
+        except (ValueError, OSError):
+            return exc.code, None
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return None, None
+
+
+def seed_bundle(workload: dict, serve_store_root: str, keep_last: int) -> int:
+    """Publish generation 0 (the untrained model) so the server has an
+    initial bundle to boot from; returns the generation number."""
+    from gan_deeplearning4j_tpu.harness import ExperimentConfig, GanExperiment
+    from gan_deeplearning4j_tpu.resilience import CheckpointStore
+
+    cfg = ExperimentConfig.from_json(workload["config"])
+    exp = GanExperiment(cfg)
+    store = CheckpointStore(serve_store_root, keep_last=keep_last)
+    info = exp.publish_for_serving(store=store)
+    return info["generation"]
+
+
+def poison_newest(serve_store_root: str, keep_last: int) -> int:
+    """Republish the newest bundle with a saturated generator — every
+    weight pushed far positive, so the sigmoid output pins at 1.0:
+    digest-valid bytes, collapsed model. Returns the poisoned generation
+    number."""
+    from gan_deeplearning4j_tpu.resilience import CheckpointStore
+    from gan_deeplearning4j_tpu.utils.serializer import read_model, write_model
+
+    store = CheckpointStore(serve_store_root, keep_last=keep_last)
+    newest = store.latest_valid()
+    number = store.next_number()
+
+    def writer(staging: str) -> None:
+        with open(os.path.join(newest.path, "serving.json")) as fh:
+            manifest = json.load(fh)
+        for name in os.listdir(newest.path):
+            if name == "MANIFEST.json":
+                continue
+            shutil.copy2(os.path.join(newest.path, name),
+                         os.path.join(staging, name))
+        gen_zip = os.path.join(staging, manifest["generator"])
+        graph, params, _, _ = read_model(gen_zip, load_updater=False)
+        import jax
+
+        poisoned = jax.tree_util.tree_map(
+            lambda a: np.full_like(np.asarray(a), 25.0), params)
+        write_model(gen_zip, graph, poisoned, save_updater=False)
+        manifest["generation"] = number
+        with open(os.path.join(staging, "serving.json"), "w") as fh:
+            json.dump(manifest, fh, indent=2)
+
+    generation = store.publish(writer, step=newest.step,
+                               extra={"kind": "serving"})
+    if generation.number != number:
+        raise RuntimeError(
+            f"poisoned bundle labeled generation {number} but the store "
+            f"assigned {generation.number} — concurrent writer?")
+    return generation.number
+
+
+class LoadGenerator:
+    """Closed-loop /v1/sample clients. Every attempt is accounted: ok,
+    shed (overloaded/deadline), error, or lost (no HTTP answer) — the
+    zero-lost / zero-shed ledger the swap invariant reads."""
+
+    def __init__(self, base: str, z_size: int, threads: int = 2):
+        self.base = base
+        self.z_size = z_size
+        self.stop = threading.Event()
+        self.counts = {"sent": 0, "ok": 0, "shed": 0, "error": 0, "lost": 0}
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+
+    def _run(self, tid: int) -> None:
+        rng = np.random.default_rng(1000 + tid)
+        while not self.stop.is_set():
+            rows = (rng.random((int(rng.integers(1, 4)), self.z_size),
+                               dtype=np.float32) * 2.0 - 1.0)
+            with self._lock:
+                self.counts["sent"] += 1
+            status, body = http_json(
+                "POST", f"{self.base}/v1/sample", {"data": rows.tolist()})
+            with self._lock:
+                if status is None:
+                    self.counts["lost"] += 1
+                elif status == 200:
+                    self.counts["ok"] += 1
+                elif status == 503:
+                    self.counts["shed"] += 1
+                else:
+                    self.counts["error"] += 1
+            time.sleep(0.005)  # keep 2 shared cores breathable
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def finish(self) -> dict:
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        return dict(self.counts)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="campaign/CI shape: 24 steps, serve-publish every 2")
+    p.add_argument("--total-steps", type=int, default=None)
+    p.add_argument("--serve-every", type=int, default=None)
+    p.add_argument("--publish-every", type=int, default=None)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--keep-last", type=int, default=10,
+                   help="serve-store retention (roomy: the server may read "
+                        "an older generation while the trainer publishes)")
+    p.add_argument("--poll", type=float, default=0.3,
+                   help="server reload-plane poll interval")
+    p.add_argument("--workdir", default=None,
+                   help="keep work files here instead of a temp dir")
+    p.add_argument("--output", default=None, metavar="PATH",
+                   help="write the drill JSON here")
+    p.add_argument("--record", default=None, metavar="TAG",
+                   help="also write BENCH_reload_<TAG>.json at the repo root")
+    args = p.parse_args(argv)
+
+    total = args.total_steps or (24 if args.smoke else 60)
+    serve_every = args.serve_every or (2 if args.smoke else 3)
+    publish_every = args.publish_every or (6 if args.smoke else 10)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="reload_drill_")
+    cleanup = args.workdir is None
+    os.makedirs(workdir, exist_ok=True)
+    serve_store = os.path.join(workdir, "store_serve")
+    train_store = os.path.join(workdir, "store_train")
+
+    workload = make_workload(workdir, args.seed)
+    results: dict = {}
+    invariants: dict = {}
+    server = worker = None
+    load = None
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+
+    try:
+        # -- phase 1: seed bundle + live server -------------------------
+        gen0 = seed_bundle(workload, serve_store, args.keep_last)
+        log(f"seeded serving generation {gen0}")
+        server_log = open(os.path.join(workdir, "server.log"), "w")
+        server = subprocess.Popen(
+            SERVER + [
+                "--reload-store", serve_store,
+                "--reload-poll", str(args.poll),
+                "--canary-data", workload["data"],
+                "--canary-samples", "48",
+                "--canary-fid-ratio", "1.1",
+                "--canary-fid-slack", "0.5",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--replicas", "1", "--buckets", "1,8",
+                "--max-latency", "0.002",
+                "--warmup", "sync", "--telemetry",
+            ],
+            cwd=_REPO, env=_ENV, stdout=server_log, stderr=server_log,
+        )
+        deadline = time.monotonic() + 240.0
+        health = None
+        while time.monotonic() < deadline:
+            if server.poll() is not None:
+                log(f"server exited rc={server.returncode} during startup")
+                return 2
+            status, health = http_json("GET", f"{base}/healthz", timeout=5.0)
+            if status == 200 and health and health.get("status") == "ok":
+                break
+            time.sleep(0.25)
+        else:
+            log("server never became healthy — cannot drill")
+            return 2
+        if health["generation"] != gen0:
+            raise RuntimeError(
+                f"server booted from generation {health['generation']}, "
+                f"expected the seeded {gen0}")
+        z_size = 4  # the drill workload's latent width (make_workload)
+        log(f"server healthy on {base}, serving generation {gen0}")
+
+        # -- phase 2: load + supervisor segment -------------------------
+        load = LoadGenerator(base, z_size)
+        load.start()
+        worker_log = open(os.path.join(workdir, "worker.log"), "w")
+        worker = subprocess.Popen(
+            WORKER + [
+                "--config", workload["config"], "--data", workload["data"],
+                "--store", train_store,
+                "--serve-store", serve_store,
+                "--total-steps", str(total),
+                "--publish-every", str(publish_every),
+                "--serve-publish-every", str(serve_every),
+                "--keep-last", str(args.keep_last),
+                "--summary", os.path.join(workdir, "worker_summary.json"),
+            ],
+            cwd=_REPO, env=_ENV, stdout=worker_log, stderr=worker_log,
+        )
+        generations_seen = [gen0]
+        t_worker = time.monotonic()
+        while worker.poll() is None:
+            if time.monotonic() - t_worker > 600.0:
+                worker.kill()
+                log("worker hung — killed")
+                break
+            status, body = http_json("GET", f"{base}/healthz", timeout=5.0)
+            if status == 200 and body:
+                g = body.get("generation")
+                if g is not None and g != generations_seen[-1]:
+                    generations_seen.append(g)
+                    log(f"server swapped to generation {g} "
+                        f"(reload: {body.get('reload')})")
+            time.sleep(0.1)
+        worker_rc = worker.returncode
+        try:
+            with open(os.path.join(workdir, "worker_summary.json")) as fh:
+                worker_summary = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            worker_summary = {}  # a dead worker breaches the invariants below
+        final_gen = worker_summary.get("final_serve_generation")
+        log(f"worker done rc={worker_rc}, final serve generation {final_gen}")
+
+        # convergence: the server must reach the trainer's final generation
+        deadline = time.monotonic() + 60.0
+        converged = False
+        while time.monotonic() < deadline:
+            status, body = http_json("GET", f"{base}/healthz", timeout=5.0)
+            if status == 200 and body:
+                g = body.get("generation")
+                if g != generations_seen[-1] and g is not None:
+                    generations_seen.append(g)
+                    log(f"server swapped to generation {g}")
+                if g == final_gen:
+                    converged = True
+                    break
+            time.sleep(0.1)
+        swaps = len(generations_seen) - 1
+        _, metrics = http_json("GET", f"{base}/metrics", timeout=5.0)
+        results["swap_phase"] = {
+            "worker_rc": worker_rc,
+            "serve_publishes": worker_summary.get("serve_publish_count"),
+            "final_serve_generation": final_gen,
+            "generations_seen": generations_seen,
+            "swaps_observed": swaps,
+            "engine_swaps_metric": (metrics or {}).get("engine_swaps"),
+            "converged_to_final": converged,
+        }
+        invariants["swaps_ge_2"] = swaps >= 2
+        invariants["converged_to_final_generation"] = converged
+
+        # the span trace must show the swap (fetched before poison-phase
+        # traffic can age it out of the ring)
+        trace_path = os.path.join(workdir, "reload_trace.json")
+        _, trace = http_json("GET", f"{base}/debug/spans", timeout=10.0)
+        span_names = {e.get("name") for e in (trace or {}).get(
+            "traceEvents", [])}
+        with open(trace_path, "w") as fh:
+            json.dump(trace or {}, fh)
+        invariants["trace_has_swap_span"] = "deploy.swap" in span_names
+        results["trace"] = {"path": trace_path,
+                            "events": len((trace or {}).get("traceEvents",
+                                                            []))}
+
+        # -- phase 3: poison + canary quarantine ------------------------
+        poison = poison_newest(serve_store, args.keep_last)
+        log(f"published poisoned generation {poison}")
+        # force the poll — but the periodic watcher may already be
+        # mid-cycle on the poison (409 is then the CORRECT busy answer),
+        # so drive to the OUTCOME: the reload plane reports a rejection
+        deadline = time.monotonic() + 120.0
+        rejected = False
+        while time.monotonic() < deadline and not rejected:
+            status, body = http_json(
+                "POST", f"{base}/admin/reload?block=1", {}, timeout=120.0)
+            log(f"forced reload: {status} "
+                f"{(body or {}).get('reload') or (body or {}).get('error')}")
+            _, h = http_json("GET", f"{base}/healthz", timeout=5.0)
+            rejected = ((h or {}).get("reload", {}).get("rejected", 0) >= 1)
+            if not rejected:
+                time.sleep(0.5)
+        # with the poison quarantined, a forced blocking poll finds
+        # nothing newer and answers 200 — the admin route's happy path
+        status, body = http_json(
+            "POST", f"{base}/admin/reload?block=1", {}, timeout=120.0)
+        _, after = http_json("GET", f"{base}/healthz", timeout=5.0)
+        from gan_deeplearning4j_tpu.resilience import CheckpointStore
+
+        entry = CheckpointStore(serve_store,
+                                keep_last=args.keep_last).entry(poison)
+        reload_state = (after or {}).get("reload", {})
+        results["poison_phase"] = {
+            "poisoned_generation": poison,
+            "admin_reload_status": status,
+            "ledger_status": entry.get("status"),
+            "quarantine_reason": entry.get("reason"),
+            "served_generation_after": (after or {}).get("generation"),
+            "reload": reload_state,
+        }
+        invariants["poison_quarantined"] = (
+            entry.get("status") == "quarantined"
+            and "canary" in (entry.get("reason") or ""))
+        invariants["poison_never_served"] = (
+            poison not in generations_seen
+            and (after or {}).get("generation") == final_gen)
+        invariants["rejection_surfaced"] = (
+            status == 200 and (reload_state.get("rejected") or 0) >= 1)
+
+        # -- phase 4: ledgers + the gauge -------------------------------
+        counts = load.finish()
+        load = None
+        results["requests"] = counts
+        invariants["zero_lost"] = (
+            counts["lost"] == 0 and counts["error"] == 0
+            and counts["ok"] == counts["sent"])
+        invariants["zero_shed_during_swaps"] = counts["shed"] == 0
+        prom_gauge = None
+        try:
+            with urllib.request.urlopen(
+                    f"{base}/metrics?format=prom", timeout=5.0) as resp:
+                for line in resp.read().decode().splitlines():
+                    if line.startswith("serving_generation "):
+                        prom_gauge = float(line.split()[-1])
+        except (urllib.error.URLError, OSError):
+            pass
+        results["serving_generation_gauge"] = prom_gauge
+        invariants["gauge_tracks_served_generation"] = (
+            prom_gauge is not None and final_gen is not None
+            and int(prom_gauge) == int(final_gen))
+    finally:
+        if load is not None:
+            load.finish()
+        for proc in (worker, server):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    # -- verdict ---------------------------------------------------------
+    ok = bool(invariants) and all(invariants.values())
+    payload = {
+        "bench": "reload_drill",
+        "config": {
+            "total_steps": total,
+            "publish_every": publish_every,
+            "serve_publish_every": serve_every,
+            "poll_interval": args.poll,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "results": results,
+        "invariants": invariants,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.output:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(os.path.join(_REPO, f"BENCH_reload_{args.record}.json"),
+                  "w") as fh:
+            fh.write(text + "\n")
+    if cleanup and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        log(f"INVARIANT BREACH — work files kept at {workdir}")
+    for name, good in sorted(invariants.items()):
+        log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,17 @@ if ! JAX_PLATFORMS=cpu timeout 600 python scripts/resilience_drill.py --smoke \
   echo "$(date +%H:%M:%S) resilience drill smoke failed — campaign aborted (see resilience_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Reload smoke (CPU, subprocess train→serve loop): the campaign's artifacts
+# feed a fleet that updates weights while serving — refuse to start if the
+# zero-downtime swap, the canary quarantine, or the supervisor's serve-
+# publish cadence regressed (>=2 swaps with zero lost/shed, poisoned
+# generation quarantined and never served — enforced by the drill's own
+# exit code). Pinned to CPU so it never touches the chip.
+if ! JAX_PLATFORMS=cpu timeout 900 python scripts/reload_drill.py --smoke \
+    --output artifacts/reload_smoke.json > reload_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) reload drill smoke failed — campaign aborted (see reload_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

@@ -1578,6 +1578,136 @@ class TestTelemetryUnfencedTiming:
 
 
 # ===========================================================================
+# JG016 — swappable engine attribute outside the lock/swap seam
+# ===========================================================================
+
+class TestSwapSeamUnguardedAccess:
+    def test_true_positive_unlocked_read_of_swapped_attribute(self):
+        # the reload-plane hazard: swap_engine rebinds self._engine under
+        # the lock, but dispatch reads it bare — a flush cut from the old
+        # engine can dispatch on the new one mid-swap
+        r = run(
+            "import threading\n"
+            "class Batcher:\n"
+            "    def __init__(self, engine):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._engine = engine\n"
+            "    def swap_engine(self, engine):\n"
+            "        with self._lock:\n"
+            "            old, self._engine = self._engine, engine\n"
+            "        return old\n"
+            "    def dispatch(self, kind, rows):\n"
+            "        return self._engine.dispatch(kind, rows)\n"
+        )
+        assert codes(r) == ["JG016"]
+        assert "outside the lock" in r.active[0].message
+
+    def test_true_positive_swap_seam_itself_unlocked(self):
+        # the worst offender: the swap method rebinds without holding the
+        # lock — every reader races the rebind (two findings: the read and
+        # the store of the tuple assignment)
+        r = run(
+            "import threading\n"
+            "class Batcher:\n"
+            "    def __init__(self, engine):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._engine = engine\n"
+            "    def swap_engine(self, engine):\n"
+            "        old, self._engine = self._engine, engine\n"
+            "        return old\n"
+        )
+        assert codes(r) == ["JG016", "JG016"]
+        assert any("rebinds" in f.message for f in r.active)
+
+    def test_true_positive_unlocked_write_in_other_method(self):
+        r = run(
+            "import threading\n"
+            "class Batcher:\n"
+            "    def __init__(self, engine):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._engine = engine\n"
+            "    def swap_engine(self, engine):\n"
+            "        with self._lock:\n"
+            "            self._engine = engine\n"
+            "    def reset(self):\n"
+            "        self._engine = None\n"
+        )
+        assert codes(r) == ["JG016"]
+
+    def test_true_negative_guarded_reads_and_snapshot(self):
+        # the corrected idiom this repo's batcher uses: accessor under the
+        # lock, worker snapshots to a local in the same critical section
+        r = run(
+            "import threading\n"
+            "class Batcher:\n"
+            "    def __init__(self, engine):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "        self._engine = engine\n"
+            "    def swap_engine(self, engine):\n"
+            "        with self._lock:\n"
+            "            old, self._engine = self._engine, engine\n"
+            "        return old\n"
+            "    @property\n"
+            "    def engine(self):\n"
+            "        with self._lock:\n"
+            "            return self._engine\n"
+            "    def worker(self, kind, rows):\n"
+            "        with self._cv:\n"
+            "            engine = self._engine\n"
+            "        return engine.dispatch(kind, rows)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_init_and_counters_exempt(self):
+        # __init__ is single-threaded by contract, and augmented counters
+        # in the swap method are not swap targets — reading them bare
+        # elsewhere is not this rule's business
+        r = run(
+            "import threading\n"
+            "class Batcher:\n"
+            "    def __init__(self, engine):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._engine = engine\n"
+            "        self._swaps = 0\n"
+            "    def swap_engine(self, engine):\n"
+            "        with self._lock:\n"
+            "            self._engine = engine\n"
+            "            self._swaps += 1\n"
+            "    def metrics(self):\n"
+            "        return {'swaps': self._swaps}\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_class_without_swap_method(self):
+        # no swap seam declared -> plain attribute use is not flagged
+        r = run(
+            "class Service:\n"
+            "    def __init__(self, engine):\n"
+            "        self._engine = engine\n"
+            "    def dispatch(self, kind, rows):\n"
+            "        return self._engine.dispatch(kind, rows)\n"
+        )
+        assert codes(r) == []
+
+    def test_suppression_applies(self):
+        r = run(
+            "import threading\n"
+            "class Batcher:\n"
+            "    def __init__(self, engine):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._engine = engine\n"
+            "    def swap_engine(self, engine):\n"
+            "        with self._lock:\n"
+            "            self._engine = engine\n"
+            "    def peek(self):\n"
+            "        return self._engine  # jaxlint: disable=JG016\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG016"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 
