@@ -32,6 +32,9 @@ from gan_deeplearning4j_tpu.analysis.rules.telemetry_fence import (
 from gan_deeplearning4j_tpu.analysis.rules.engine_swap import (
     SwapSeamUnguardedAccess,
 )
+from gan_deeplearning4j_tpu.analysis.rules.net_timeout import (
+    UnboundedNetworkCall,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -50,6 +53,7 @@ RULES = [
     CrossModulePrngReuse(),
     TelemetryUnfencedTiming(),
     SwapSeamUnguardedAccess(),
+    UnboundedNetworkCall(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -35,6 +35,9 @@ from gan_deeplearning4j_tpu.deploy.canary import (
     CanaryDecision,
     CanaryGate,
     CanaryThresholds,
+    compare_probes,
+    classifier_from_bundle,
+    feature_fn_from_checkpoint,
     load_quality_probe,
 )
 from gan_deeplearning4j_tpu.deploy.reloader import (
@@ -53,5 +56,8 @@ __all__ = [
     "ReloadController",
     "STATES",
     "StoreWatcher",
+    "compare_probes",
+    "classifier_from_bundle",
+    "feature_fn_from_checkpoint",
     "load_quality_probe",
 ]

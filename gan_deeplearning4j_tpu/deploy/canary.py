@@ -35,8 +35,9 @@ from __future__ import annotations
 
 import dataclasses
 import importlib.util
+import json
 import os
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
@@ -82,6 +83,74 @@ class CanaryDecision:
     reason: str
     candidate: dict
     incumbent: dict
+
+
+def compare_probes(candidate: dict, incumbent: dict,
+                   thresholds: Optional[CanaryThresholds] = None
+                   ) -> CanaryDecision:
+    """The admission decision on two ALREADY-MEASURED probe dicts
+    (``{"fid": float, "accuracy": float|None}``) — the seam the fleet
+    manager's sidecar canary shares with the in-process gate: probes may
+    run anywhere (another process, another host), but what "passes"
+    means is defined exactly once (docs/FLEET.md)."""
+    t = thresholds or CanaryThresholds()
+    failures = []
+    fid_limit = incumbent["fid"] * t.fid_ratio_max + t.fid_slack
+    # written as not-<= so a NaN probe (degenerate samples) fails the
+    # gate instead of slipping past a > comparison
+    if not (candidate["fid"] <= fid_limit):
+        failures.append(
+            f"fid {candidate['fid']:.4g} exceeds limit {fid_limit:.4g} "
+            f"(incumbent {incumbent['fid']:.4g} × {t.fid_ratio_max} + "
+            f"{t.fid_slack})")
+    if (candidate.get("accuracy") is not None
+            and incumbent.get("accuracy") is not None):
+        floor = incumbent["accuracy"] - t.accuracy_drop_max
+        if not (candidate["accuracy"] >= floor):
+            failures.append(
+                f"accuracy {candidate['accuracy']:.4f} below floor "
+                f"{floor:.4f} (incumbent {incumbent['accuracy']:.4f} - "
+                f"{t.accuracy_drop_max})")
+    return CanaryDecision(
+        passed=not failures,
+        reason="; ".join(failures) if failures else "ok",
+        candidate=candidate,
+        incumbent=incumbent,
+    )
+
+
+def feature_fn_from_checkpoint(classifier_path: str, vertex: str,
+                               batch_size: int = 500):
+    """Discriminator-feature extractor for the canary's FID: rows →
+    activations at ``vertex`` of the checkpointed classifier (the
+    dis-feature space the paper's transfer claim is about). The weights
+    are pinned at load time, so candidate and incumbent are embedded in
+    the SAME space regardless of how many generations later the gate
+    runs — what ``--canary-feature dis_features`` maps to."""
+    from gan_deeplearning4j_tpu.eval.fid import graph_feature_fn
+    from gan_deeplearning4j_tpu.utils.serializer import read_model
+
+    graph, params, _, _ = read_model(classifier_path, load_updater=False)
+    if vertex not in {v.name for v in graph.vertices}:
+        raise ValueError(
+            f"feature vertex {vertex!r} is not a vertex of the classifier "
+            f"graph")
+    return graph_feature_fn(graph, params, vertex, batch_size=batch_size)
+
+
+def classifier_from_bundle(directory: str) -> Optional[Tuple[str, str]]:
+    """(classifier checkpoint path, feature vertex) from a serving
+    bundle's ``serving.json``, or None when the bundle serves no
+    dis-feature space — the one manifest resolution behind both the
+    serving CLI's ``--canary-feature dis_features`` and the sidecar
+    probe's ``--feature-bundle``."""
+    with open(os.path.join(directory, "serving.json")) as fh:
+        manifest = json.load(fh)
+    name = manifest.get("classifier")
+    vertex = manifest.get("feature_vertex")
+    if name and vertex:
+        return os.path.join(directory, name), vertex
+    return None
 
 
 class CanaryGate:
@@ -147,28 +216,13 @@ class CanaryGate:
 
     # -- the gate --------------------------------------------------------
     def evaluate(self, candidate, incumbent) -> CanaryDecision:
-        """Admit or reject ``candidate`` relative to ``incumbent``."""
+        """Admit or reject ``candidate`` relative to ``incumbent`` — the
+        measurement here, the decision in :func:`compare_probes` (shared
+        with the fleet manager's sidecar canary)."""
         inc = self._incumbent_probe(incumbent)
         cand = self.probe(candidate)
-        t = self.thresholds
-        failures = []
-        fid_limit = inc["fid"] * t.fid_ratio_max + t.fid_slack
-        # written as not-<= so a NaN probe (degenerate samples) fails the
-        # gate instead of slipping past a > comparison
-        if not (cand["fid"] <= fid_limit):
-            failures.append(
-                f"fid {cand['fid']:.4g} exceeds limit {fid_limit:.4g} "
-                f"(incumbent {inc['fid']:.4g} × {t.fid_ratio_max} + "
-                f"{t.fid_slack})")
-        if (cand.get("accuracy") is not None
-                and inc.get("accuracy") is not None):
-            floor = inc["accuracy"] - t.accuracy_drop_max
-            if not (cand["accuracy"] >= floor):
-                failures.append(
-                    f"accuracy {cand['accuracy']:.4f} below floor "
-                    f"{floor:.4f} (incumbent {inc['accuracy']:.4f} - "
-                    f"{t.accuracy_drop_max})")
-        if not failures:
+        decision = compare_probes(cand, inc, self.thresholds)
+        if decision.passed:
             # the admitted candidate is about to BECOME the incumbent:
             # roll the cache forward so the next reload reuses its probe
             # (one candidate probe per reload) and the retired engine's
@@ -176,9 +230,4 @@ class CanaryGate:
             # released instead of pinned until the next evaluate
             self._incumbent_cache = (
                 (candidate, getattr(candidate, "generation", None)), cand)
-        return CanaryDecision(
-            passed=not failures,
-            reason="; ".join(failures) if failures else "ok",
-            candidate=cand,
-            incumbent=inc,
-        )
+        return decision

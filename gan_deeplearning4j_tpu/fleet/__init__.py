@@ -1,0 +1,65 @@
+"""fleet/ — fault-tolerant multi-process serving.
+
+PR 7 made one serving process survive model updates; this package makes
+the serving PLANE survive processes. One stdlib router fronts N worker
+processes (each a ``python -m gan_deeplearning4j_tpu.serving`` instance),
+and three cooperating pieces keep every submitted request answered
+exactly once while workers die, hang, warm, and upgrade underneath it —
+the fault-tolerance-as-design-axis argument of the TensorFlow system
+paper (PAPERS.md), applied to the serve side:
+
+- :mod:`.router` — power-of-two-choices proxying over scraped worker
+  ``/metrics``, per-request timeouts, and a token-bucket retry budget
+  (shed/connect-failed attempts retry on a different worker with
+  exponential backoff + jitter; an exhausted budget answers an honest
+  503, never a retry storm);
+- :mod:`.health` — active ``/healthz`` probing plus passive outlier
+  ejection: consecutive failures or a windowed error rate trip a
+  per-worker circuit breaker (ejected → half-open → one probe →
+  re-admitted), so a SIGKILLed, hung, warming, or draining worker leaves
+  and rejoins the pool without operator action;
+- :mod:`.manager` — process lifecycle: spawn from a shared checkpoint
+  store, relaunch on death, force-restart on hang, **draining restarts**
+  (unroute → drain via ``/metrics`` → SIGTERM → relaunch → re-admit
+  warm), and rolling generation upgrades admitted by ONE fleet-level
+  canary decision (sidecar probes + ``deploy.compare_probes``), with
+  halt-and-quarantine on regression.
+
+``python -m gan_deeplearning4j_tpu.fleet`` runs the whole plane;
+``scripts/fleet_drill.py`` proves the invariants against real faults.
+Architecture notes: docs/FLEET.md.
+"""
+
+from gan_deeplearning4j_tpu.fleet.health import (
+    ADMITTABLE,
+    CircuitBreaker,
+    probe_worker,
+)
+from gan_deeplearning4j_tpu.fleet.manager import (
+    FleetManager,
+    WorkerProcess,
+    WorkerSlot,
+)
+from gan_deeplearning4j_tpu.fleet.router import (
+    FleetRouter,
+    NoWorkerAvailable,
+    RetryBudget,
+    WorkerRef,
+    make_router_server,
+    scrape_metrics,
+)
+
+__all__ = [
+    "ADMITTABLE",
+    "CircuitBreaker",
+    "FleetManager",
+    "FleetRouter",
+    "NoWorkerAvailable",
+    "RetryBudget",
+    "WorkerProcess",
+    "WorkerRef",
+    "WorkerSlot",
+    "make_router_server",
+    "probe_worker",
+    "scrape_metrics",
+]

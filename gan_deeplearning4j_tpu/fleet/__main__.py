@@ -1,0 +1,160 @@
+"""Fleet CLI — ``python -m gan_deeplearning4j_tpu.fleet [flags]``.
+
+Boots the whole serving plane from one checkpoint store: N worker
+processes (spawned from the newest digest-valid serving generation), the
+health-ejecting router in front of them, and the manager's supervise +
+rolling-upgrade loop. Runs until interrupted. Example::
+
+    python -m gan_deeplearning4j_tpu.fleet --store bundles \\
+        --workers 3 --port 8100 --canary-data canary.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gan_deeplearning4j_tpu.fleet",
+        description="multi-process serving fleet: router + N workers",
+    )
+    p.add_argument("--store", required=True,
+                   help="checkpoint store root holding serving generations")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100, help="router port")
+    p.add_argument("--worker-ports", default=None,
+                   help="comma-separated worker ports (default: free ports)")
+    p.add_argument("--log-dir", default=".",
+                   help="where worker-<id>.log files land")
+    p.add_argument("--boot-wait", type=float, default=120.0,
+                   help="seconds to wait for the first valid serving "
+                        "generation in the store")
+    p.add_argument("--poll", type=float, default=2.0,
+                   help="store poll interval for rolling upgrades")
+    p.add_argument("--request-timeout", type=float, default=10.0,
+                   help="per-proxied-request timeout at the router")
+    p.add_argument("--probe-interval", type=float, default=0.25,
+                   help="health loop cadence (probes + /metrics scrapes)")
+    p.add_argument("--retry-ratio", type=float, default=0.2,
+                   help="retry-budget deposit per proxied request")
+    p.add_argument("--retry-burst", type=float, default=10.0,
+                   help="retry-budget token cap")
+    p.add_argument("--eject-failures", type=int, default=3,
+                   help="consecutive failures that eject a worker")
+    p.add_argument("--reopen-after", type=float, default=1.0,
+                   help="initial ejected→half-open backoff seconds")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="bounded wait for a draining worker's pipeline")
+    p.add_argument("--warm-timeout", type=float, default=300.0,
+                   help="bounded wait for a relaunched worker to go healthy")
+    p.add_argument("--hang-restart", type=float, default=20.0,
+                   help="force-restart a worker whose breaker stays open "
+                        "this long while its process is alive")
+    p.add_argument("--buckets", default=None,
+                   help="worker batch ladder, e.g. 1,8,32,128")
+    p.add_argument("--replicas", default=None,
+                   help="device replicas per worker (int or 'all')")
+    p.add_argument("--max-latency", type=float, default=None,
+                   help="worker micro-batch trigger seconds")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="worker default per-request deadline seconds")
+    p.add_argument("--canary-data", default=None, metavar="NPZ",
+                   help="npz with 'features' (and optionally 'labels') for "
+                        "the fleet admission gate; omitted = digest-valid "
+                        "generations roll ungated")
+    p.add_argument("--canary-samples", type=int, default=256)
+    p.add_argument("--canary-seed", type=int, default=666)
+    p.add_argument("--canary-feature", choices=("raw", "dis_features"),
+                   default="raw",
+                   help="FID feature space for the admission probes "
+                        "(dis_features: the incumbent classifier's feature "
+                        "vertex — docs/FLEET.md)")
+    p.add_argument("--canary-fid-ratio", type=float, default=1.5)
+    p.add_argument("--canary-fid-slack", type=float, default=10.0)
+    p.add_argument("--canary-acc-drop", type=float, default=0.05)
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable span tracing on the router/manager process")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    from gan_deeplearning4j_tpu.deploy import CanaryThresholds
+    from gan_deeplearning4j_tpu.fleet.manager import FleetManager
+    from gan_deeplearning4j_tpu.fleet.router import (
+        FleetRouter,
+        make_router_server,
+    )
+    from gan_deeplearning4j_tpu.telemetry.trace import TRACER, configure_from_env
+
+    if args.telemetry:
+        TRACER.enable()
+    else:
+        configure_from_env()
+    worker_args = []
+    if args.buckets:
+        worker_args += ["--buckets", args.buckets]
+    if args.replicas is not None:
+        worker_args += ["--replicas", str(args.replicas)]
+    if args.max_latency is not None:
+        worker_args += ["--max-latency", str(args.max_latency)]
+    if args.timeout is not None:
+        worker_args += ["--timeout", str(args.timeout)]
+    ports = None
+    if args.worker_ports:
+        ports = [int(x) for x in args.worker_ports.split(",") if x.strip()]
+        if len(ports) != args.workers:
+            p.error(f"--worker-ports names {len(ports)} ports for "
+                    f"--workers {args.workers}")
+    router = FleetRouter(
+        request_timeout=args.request_timeout,
+        probe_interval=args.probe_interval,
+        retry_ratio=args.retry_ratio,
+        retry_burst=args.retry_burst,
+        breaker_kwargs={
+            "consecutive_failures": args.eject_failures,
+            "reopen_after": args.reopen_after,
+        },
+    )
+    manager = FleetManager(
+        router, args.store,
+        num_workers=args.workers, ports=ports, host=args.host,
+        worker_args=worker_args, log_dir=args.log_dir,
+        poll_interval=args.poll,
+        drain_timeout=args.drain_timeout,
+        warm_timeout=args.warm_timeout,
+        hang_restart_after=args.hang_restart,
+        canary_data=args.canary_data,
+        canary_samples=args.canary_samples,
+        canary_seed=args.canary_seed,
+        canary_feature=args.canary_feature,
+        thresholds=CanaryThresholds(
+            fid_ratio_max=args.canary_fid_ratio,
+            fid_slack=args.canary_fid_slack,
+            accuracy_drop_max=args.canary_acc_drop,
+        ),
+    )
+    log = logging.getLogger(__name__)
+    # bind the router port BEFORE spawning workers: a bind failure must
+    # not leave N orphaned worker subprocesses behind
+    server = make_router_server(router, args.host, args.port)
+    try:
+        manager.start(boot_wait=args.boot_wait)
+        log.info("fleet router on http://%s:%d (%d workers, generation %s)",
+                 args.host, server.server_address[1], len(manager.slots),
+                 manager.generation)
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

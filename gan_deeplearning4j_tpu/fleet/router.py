@@ -1,0 +1,532 @@
+"""FleetRouter — the multi-process serving front end.
+
+One stdlib ``ThreadingHTTPServer`` proxies ``/v1/*`` to N serving worker
+processes. Three mechanisms make the fleet tolerate what a single process
+cannot (docs/FLEET.md):
+
+- **power-of-two-choices routing** — each request picks two random
+  routable workers and takes the less loaded one (local in-flight count
+  plus the queue depth scraped from the worker's ``/metrics``). P2C gets
+  most of the benefit of full least-loaded routing without herding every
+  request onto one briefly-idle worker between scrapes.
+- **retry budget** — a shed (worker 503) or connect-failed attempt is
+  retried on a *different* worker with exponential backoff + jitter, but
+  only while the token bucket holds a token (deposits accrue per proxied
+  request at ``retry_ratio``, capped at ``retry_burst``). Budget
+  exhausted ⇒ an honest 503 — under a fleet-wide brownout the router
+  amplifies load by at most ``1 + retry_ratio``, never a retry storm.
+- **health ejection** — every proxied outcome feeds the worker's
+  :class:`~.health.CircuitBreaker` (passive), and a health loop probes
+  ``/healthz`` actively (admission, half-open re-admission) and scrapes
+  ``/metrics`` (load + liveness). A SIGKILLed, hung, warming, or
+  draining worker silently leaves the pool and rejoins when healthy.
+
+Exactly-one-answer is the router's contract: every accepted request gets
+exactly one HTTP response — success, the worker's own non-retryable
+answer, or an honest 503. A timed-out attempt may still execute on the
+worker (inference is idempotent; the client gets the retry's answer).
+
+The router never touches model bytes and adds no serve-time compiles —
+the bounded-compile invariant is per worker and re-routing cannot break
+it (the drill asserts each worker's ``serve_compile_counts`` stays 0).
+Every network call here carries an explicit timeout — jaxlint JG017
+polices that on this path.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
+
+from gan_deeplearning4j_tpu.fleet.health import (
+    CircuitBreaker,
+    http_json,
+    probe_worker,
+)
+from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
+logger = logging.getLogger(__name__)
+
+
+class RetryBudget:
+    """Token bucket bounding fleet-wide retry amplification. Each proxied
+    request deposits ``ratio`` tokens (capped at ``burst``); each retry
+    spends one. Starts full so a cold router can absorb a worker death
+    immediately."""
+
+    def __init__(self, ratio: float = 0.2, burst: float = 10.0):
+        if ratio < 0 or burst < 1:
+            raise ValueError("retry ratio must be >= 0 and burst >= 1")
+        self.ratio = ratio
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+
+    def spend(self) -> bool:
+        """Take one token; False means the budget is exhausted and the
+        caller must answer 503 instead of retrying."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def refund(self) -> None:
+        """Return a spent token (capped at burst): a retry that found no
+        worker to land on never amplified load, so it must not count
+        against requests whose retry WOULD reach a live worker."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + 1.0)
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+class WorkerRef:
+    """The router's view of one worker process."""
+
+    def __init__(self, worker_id: str, base_url: str, *, pid=None,
+                 breaker: Optional[CircuitBreaker] = None):
+        self.id = worker_id
+        self.base_url = base_url.rstrip("/")
+        self.pid = pid
+        self.breaker = breaker or CircuitBreaker()
+        self.draining = False
+        self._lock = threading.Lock()
+        self._inflight = 0  # requests this router is running there NOW
+        self._scraped: dict = {}  # last /metrics snapshot (queue, gen, ...)
+        self.counts = {"ok": 0, "shed": 0, "failed": 0}
+
+    # -- load accounting (p2c input) -------------------------------------
+    def begin(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def count(self, outcome: str) -> None:
+        """Record one proxied-attempt outcome ("ok"/"shed"/"failed")."""
+        with self._lock:
+            self.counts[outcome] += 1
+
+    @property
+    def load(self) -> int:
+        with self._lock:
+            scraped = self._scraped
+            return (self._inflight
+                    + int(scraped.get("queue_depth", 0))
+                    + int(scraped.get("in_flight", 0)))
+
+    def update_scrape(self, metrics: dict) -> None:
+        with self._lock:
+            self._scraped = {
+                "queue_depth": metrics.get("queue_depth", 0),
+                "in_flight": metrics.get("pipeline", {}).get("in_flight", 0),
+                "generation": metrics.get("generation"),
+                "draining": metrics.get("draining", False),
+                "serve_compile_counts": metrics.get("engine", {}).get(
+                    "serve_compile_counts", {}),
+                "at": time.monotonic(),
+            }
+
+    @property
+    def generation(self):
+        with self._lock:
+            return self._scraped.get("generation")
+
+    @property
+    def routable(self) -> bool:
+        with self._lock:
+            # the worker's own /metrics "draining" flag: a worker drained
+            # directly (POST /admin/drain, not through the manager) must
+            # leave the pool too, not keep receiving /v1 traffic its
+            # pipeline will never empty of
+            self_drained = bool(self._scraped.get("draining", False))
+        return (self.breaker.routable and not self.draining
+                and not self_drained)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            scraped = dict(self._scraped)
+            inflight = self._inflight
+            counts = dict(self.counts)
+        return {
+            "id": self.id,
+            "base_url": self.base_url,
+            "pid": self.pid,
+            "draining": self.draining,
+            "breaker": self.breaker.snapshot(),
+            "routable": self.routable,
+            "inflight": inflight,
+            "generation": scraped.get("generation"),
+            "queue_depth": scraped.get("queue_depth"),
+            "counts": counts,
+        }
+
+
+class NoWorkerAvailable(RuntimeError):
+    """Every worker is ejected, draining, or already tried."""
+
+
+class FleetRouter:
+    """Routing + health state over a set of :class:`WorkerRef`. The HTTP
+    front end (:func:`make_router_server`) and the drill both drive
+    :meth:`handle`; the manager registers/ejects/drains workers."""
+
+    def __init__(self, *, request_timeout: float = 10.0,
+                 probe_timeout: float = 2.0, probe_interval: float = 0.25,
+                 retry_ratio: float = 0.2, retry_burst: float = 10.0,
+                 max_attempts: int = 3, backoff_base: float = 0.02,
+                 backoff_max: float = 0.25, seed: int = 0,
+                 breaker_kwargs: Optional[dict] = None):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.request_timeout = request_timeout
+        self.probe_timeout = probe_timeout
+        self.probe_interval = probe_interval
+        self.max_attempts = max_attempts
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.budget = RetryBudget(retry_ratio, retry_burst)
+        self._breaker_kwargs = breaker_kwargs or {}
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._workers: Dict[str, WorkerRef] = {}
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self.manager = None  # FleetManager, when attached (POST /admin/poll)
+        self.started_at = time.time()
+        # -- counters ----------------------------------------------------
+        self._counts = {"proxied": 0, "ok": 0, "error": 0, "retries": 0,
+                        "budget_exhausted": 0, "no_worker": 0,
+                        "attempts_exhausted": 0, "ejections": 0}
+        registry = get_registry()
+        self._c_requests = registry.counter(
+            "fleet_requests_total", "router request outcomes",
+            labelnames=("outcome",))
+        self._c_retries = registry.counter(
+            "fleet_retries_total", "attempts re-routed to another worker")
+        self._c_exhausted = registry.counter(
+            "fleet_retry_budget_exhausted_total",
+            "requests answered 503 because the retry budget was empty")
+        self._c_ejections = registry.counter(
+            "fleet_ejections_total", "circuit-breaker trips across workers")
+        self._g_routable = registry.gauge(
+            "fleet_workers_routable", "workers currently in the routable pool")
+
+    # -- worker registry -------------------------------------------------
+    def add_worker(self, worker_id: str, base_url: str, pid=None
+                   ) -> WorkerRef:
+        ref = WorkerRef(worker_id, base_url, pid=pid,
+                        breaker=CircuitBreaker(**self._breaker_kwargs))
+        with self._lock:
+            self._workers[worker_id] = ref
+        return ref
+
+    def remove_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.pop(worker_id, None)
+
+    def worker(self, worker_id: str) -> WorkerRef:
+        with self._lock:
+            return self._workers[worker_id]
+
+    def workers(self) -> List[WorkerRef]:
+        with self._lock:
+            return list(self._workers.values())
+
+    def mark_draining(self, worker_id: str, draining: bool = True) -> None:
+        """Manager-side drain mark: the worker leaves the routable pool
+        immediately; in-flight proxied requests still finish."""
+        self.worker(worker_id).draining = draining
+
+    # -- selection -------------------------------------------------------
+    def _pick(self, exclude: set) -> WorkerRef:
+        candidates = [w for w in self.workers()
+                      if w.routable and w.id not in exclude]
+        if not candidates:
+            raise NoWorkerAvailable(
+                "no routable worker (all ejected, draining, or tried)")
+        if len(candidates) == 1:
+            return candidates[0]
+        with self._lock:  # Random() is not thread-safe
+            a, b = self._rng.sample(candidates, 2)
+        return a if a.load <= b.load else b
+
+    # -- the proxy -------------------------------------------------------
+    def _attempt(self, ref: WorkerRef, method: str, path: str,
+                 body: Optional[bytes]) -> Tuple[int, bytes]:
+        """One proxied attempt. Raises OSError-family on connection-level
+        failure (dead/hung worker); returns the worker's (status, body)
+        otherwise."""
+        host, _, port = ref.base_url.rpartition("//")[2].partition(":")
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=self.request_timeout)
+        try:
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def handle(self, method: str, path: str, body: Optional[bytes]
+               ) -> Tuple[int, bytes]:
+        """Route one ``/v1/*`` request: p2c pick, proxy, retry shed and
+        connect-failed attempts on a different worker under the budget.
+        Always returns exactly one response."""
+        self.budget.deposit()
+        with self._lock:
+            self._counts["proxied"] += 1
+        tried: set = set()
+        retryable: Optional[str] = None
+        for attempt in range(self.max_attempts):
+            if attempt > 0:
+                if not self.budget.spend():
+                    with self._lock:
+                        self._counts["budget_exhausted"] += 1
+                        self._counts["error"] += 1
+                    self._c_exhausted.inc()
+                    self._c_requests.labels(outcome="budget_exhausted").inc()
+                    return 503, _json_body(
+                        "overloaded",
+                        f"retry budget exhausted after {retryable}")
+                with self._lock:
+                    self._counts["retries"] += 1
+                    jitter = 0.5 + self._rng.random() * 0.5
+                self._c_retries.inc()
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** (attempt - 1)))
+                time.sleep(delay * jitter)
+            try:
+                ref = self._pick(tried)
+            except NoWorkerAvailable as exc:
+                # fast 503, never a hang: an all-ejected fleet answers in
+                # O(1) instead of blocking clients on dead sockets
+                if attempt > 0:
+                    # the spent token bought no retry — refund it, or a
+                    # brownout with one survivor drains the shared bucket
+                    # on retries that never happen
+                    self.budget.refund()
+                with self._lock:
+                    self._counts["no_worker"] += 1
+                    self._counts["error"] += 1
+                self._c_requests.labels(outcome="no_worker").inc()
+                return 503, _json_body("overloaded", str(exc))
+            tried.add(ref.id)
+            ref.begin()
+            t0 = time.perf_counter()
+            try:
+                status, payload = self._attempt(ref, method, path, body)
+            except (OSError, http.client.HTTPException) as exc:
+                # connection-level failure: the worker is gone or hung —
+                # passive ejection signal, retryable on another worker
+                retryable = f"{type(exc).__name__}: {exc}"
+                ref.count("failed")
+                if ref.breaker.record(False) == "tripped":
+                    self._note_ejection(ref, retryable)
+                continue
+            finally:
+                ref.end()
+                if TRACER.enabled:
+                    TRACER.complete("fleet.proxy", t0, time.perf_counter(),
+                                    {"worker": ref.id, "path": path,
+                                     "attempt": attempt})
+            if status == 503:
+                # the worker answered but shed (overloaded/deadline):
+                # alive for the breaker, retryable for the client
+                retryable = f"worker {ref.id} shed (503)"
+                ref.breaker.record(True)
+                ref.count("shed")
+                continue
+            ref.breaker.record(True)
+            ref.count("ok")
+            with self._lock:
+                self._counts["ok" if status < 400 else "error"] += 1
+            self._c_requests.labels(
+                outcome="ok" if status < 400 else "worker_error").inc()
+            return status, payload
+        # attempts exhausted on retryable failures
+        with self._lock:
+            self._counts["attempts_exhausted"] += 1
+            self._counts["error"] += 1
+        self._c_requests.labels(outcome="attempts_exhausted").inc()
+        return 503, _json_body(
+            "overloaded",
+            f"all {self.max_attempts} attempts failed ({retryable})")
+
+    def _note_ejection(self, ref: WorkerRef, reason: str) -> None:
+        with self._lock:
+            self._counts["ejections"] += 1
+        self._c_ejections.inc()
+        logger.warning("worker %s ejected: %s", ref.id, reason)
+
+    # -- the health loop -------------------------------------------------
+    def start_health_loop(self) -> threading.Thread:
+        with self._lock:
+            if (self._health_thread is not None
+                    and self._health_thread.is_alive()):
+                return self._health_thread
+            self._stop.clear()
+            t = threading.Thread(target=self._health_loop,
+                                 name="fleet-health", daemon=True)
+            self._health_thread = t
+        t.start()
+        return t
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._health_thread
+        if t is not None:
+            t.join(timeout)
+
+    def health_pass(self) -> None:
+        """One probe/scrape sweep over every worker (the loop body, also
+        driven directly by tests and the manager's wait paths)."""
+        for ref in self.workers():
+            if ref.breaker.probe_due():
+                ok, _ = probe_worker(ref.base_url, timeout=self.probe_timeout)
+                if ref.breaker.probe_result(ok) == "admitted":
+                    logger.info("worker %s admitted to the pool", ref.id)
+                continue
+            if not ref.breaker.routable:
+                continue  # open: wait out the backoff, probe when half-open
+            metrics = scrape_metrics(ref.base_url, timeout=self.probe_timeout)
+            if metrics is None:
+                # a hung worker with no traffic still gets ejected: the
+                # scrape IS the passive signal then
+                if ref.breaker.record(False) == "tripped":
+                    self._note_ejection(ref, "metrics scrape failed")
+            else:
+                # a successful scrape is NOT recorded as a passive
+                # success: a worker whose /v1 path is wedged but whose
+                # HTTP server still answers /metrics must not have its
+                # proxied-failure streak washed out by scrape successes
+                ref.update_scrape(metrics)
+        self._g_routable.set(sum(1 for w in self.workers() if w.routable))
+
+    def _health_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.health_pass()
+            except Exception:  # a probe bug must not kill the loop
+                logger.exception("health pass failed")
+            self._stop.wait(self.probe_interval)
+
+    # -- observability ---------------------------------------------------
+    def healthz(self) -> dict:
+        workers = [w.snapshot() for w in self.workers()]
+        routable = [w for w in workers if w["routable"]]
+        generations = sorted({w["generation"] for w in routable
+                              if w["generation"] is not None})
+        status = ("ok" if routable else "down")
+        body = {
+            "status": status,
+            "role": "router",
+            "workers": workers,
+            "routable": len(routable),
+            # the fleet generation: the one every routable worker agrees
+            # on, else None (mid-roll)
+            "generation": generations[0] if len(generations) == 1 else None,
+            "generations": generations,
+        }
+        if self.manager is not None:
+            body["fleet"] = self.manager.status()
+        return body
+
+    def metrics(self) -> dict:
+        with self._lock:
+            counts = dict(self._counts)
+        return {
+            **counts,
+            "retry_budget_tokens": self.budget.tokens,
+            "workers": [w.snapshot() for w in self.workers()],
+        }
+
+
+def _json_body(status: str, error: str) -> bytes:
+    return json.dumps({"status": status, "error": error}).encode()
+
+
+def scrape_metrics(base_url: str, timeout: float = 2.0) -> Optional[dict]:
+    """One bounded ``GET /metrics`` scrape; None on any failure."""
+    return http_json(f"{base_url}/metrics", timeout=timeout)
+
+
+# -- HTTP front end ---------------------------------------------------------
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: FleetRouter = None  # bound by make_router_server
+
+    def _respond(self, status: int, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (http.server naming contract)
+        try:
+            route, _, _ = self.path.partition("?")
+            if route == "/healthz":
+                self._respond(200, json.dumps(self.router.healthz()).encode())
+            elif route == "/metrics":
+                self._respond(200, json.dumps(self.router.metrics()).encode())
+            else:
+                self._respond(404, _json_body("error",
+                                              f"no route GET {route}"))
+        except Exception as exc:  # a handler bug must answer, not reset
+            logger.exception("GET %s failed", self.path)
+            self._respond(500, _json_body(
+                "error", f"{type(exc).__name__}: {exc}"))
+
+    def do_POST(self):  # noqa: N802
+        try:
+            route, _, query = self.path.partition("?")
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else None
+            if route.startswith("/v1/"):
+                status, payload = self.router.handle("POST", self.path, body)
+                self._respond(status, payload)
+                return
+            if route == "/admin/poll" and self.router.manager is not None:
+                params = parse_qs(query) if query else {}
+                wait = params.get("block", ["0"])[0] not in ("0", "",
+                                                             "false")
+                state = self.router.manager.poll_now(wait=wait)
+                self._respond(200 if wait else 202, json.dumps(
+                    {"status": "ok", "fleet": state}).encode())
+                return
+            self._respond(404, _json_body("error", f"no route POST {route}"))
+        except Exception as exc:
+            logger.exception("POST %s failed", self.path)
+            self._respond(500, _json_body(
+                "error", f"{type(exc).__name__}: {exc}"))
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+def make_router_server(router: FleetRouter, host: str = "127.0.0.1",
+                       port: int = 8100) -> ThreadingHTTPServer:
+    """Bind (but do not start) the router's HTTP front end; ``port=0``
+    picks a free port (tests)."""
+    handler = type("BoundRouterHandler", (_RouterHandler,),
+                   {"router": router})
+    return ThreadingHTTPServer((host, port), handler)

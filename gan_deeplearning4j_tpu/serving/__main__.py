@@ -86,6 +86,14 @@ def main(argv=None) -> int:
                         "quality gate (digest verification still applies)")
     p.add_argument("--canary-samples", type=int, default=256,
                    help="seeded probe batch size for the canary gate")
+    p.add_argument("--canary-feature", choices=("raw", "dis_features"),
+                   default="raw",
+                   help="FID feature space for the canary probes: 'raw' "
+                        "compares raw sample rows; 'dis_features' embeds "
+                        "both sides in the discriminator-feature space of "
+                        "the BOOT bundle's classifier at its feature "
+                        "vertex (pinned at startup so every candidate is "
+                        "scored in one space — docs/DEPLOY.md)")
     p.add_argument("--canary-fid-ratio", type=float, default=1.5,
                    help="reject a candidate whose probe FID exceeds "
                         "incumbent × ratio + slack")
@@ -126,10 +134,13 @@ def main(argv=None) -> int:
         from gan_deeplearning4j_tpu.resilience import CheckpointStore
 
         watcher = StoreWatcher(store=CheckpointStore(args.reload_store))
+    canary_bundle = None  # bundle dir a dis-feature classifier resolves from
+    canary_classifier = None  # (checkpoint, vertex) for dis-feature probes
     if args.bundle is not None:
         engine = ServingEngine.from_bundle(
             args.bundle, buckets=args.buckets, replicas=replicas
         )
+        canary_bundle = args.bundle
     elif args.generator or args.classifier:
         engine = ServingEngine.from_checkpoints(
             generator=args.generator,
@@ -138,6 +149,8 @@ def main(argv=None) -> int:
             feature_vertex=args.feature_vertex,
             replicas=replicas,
         )
+        if args.classifier and args.feature_vertex:
+            canary_classifier = (args.classifier, args.feature_vertex)
     elif watcher is not None:
         # bootstrap from the watched store: the first valid serving
         # generation is the initial model (a trainer may still be warming
@@ -161,6 +174,7 @@ def main(argv=None) -> int:
         engine = ServingEngine.from_bundle(
             candidate.path, buckets=args.buckets, replicas=replicas
         )
+        canary_bundle = candidate.path
     else:
         p.error("need --bundle, --generator/--classifier, or --reload-store")
         return 2  # unreachable; argparse exits
@@ -181,12 +195,31 @@ def main(argv=None) -> int:
 
         canary = None
         if args.canary_data:
+            feature_fn = None
+            if args.canary_feature == "dis_features":
+                if canary_classifier is None and canary_bundle is not None:
+                    # resolved lazily: only this branch needs the manifest
+                    from gan_deeplearning4j_tpu.deploy.canary import (
+                        classifier_from_bundle,
+                    )
+
+                    canary_classifier = classifier_from_bundle(canary_bundle)
+                if canary_classifier is None:
+                    p.error("--canary-feature dis_features needs a boot "
+                            "bundle (or --classifier/--feature-vertex) "
+                            "serving a dis-feature vertex")
+                from gan_deeplearning4j_tpu.deploy import (
+                    feature_fn_from_checkpoint,
+                )
+
+                feature_fn = feature_fn_from_checkpoint(*canary_classifier)
             with np.load(args.canary_data) as npz:
                 features = npz["features"]
                 labels = npz["labels"] if "labels" in npz.files else None
             canary = CanaryGate(
                 features, labels,
                 num_samples=min(args.canary_samples, features.shape[0]),
+                feature_fn=feature_fn,
                 thresholds=CanaryThresholds(
                     fid_ratio_max=args.canary_fid_ratio,
                     fid_slack=args.canary_fid_slack,

@@ -10,8 +10,8 @@ server-side batching (*TensorFlow: a system for large-scale ML*, §4.3).
 Execution is a TWO-STAGE PIPELINE (the continuous-batching shape of the
 serving literature — Orca-style iteration-level scheduling in PAPERS.md):
 a worker thread cuts a batch and *dispatches* it (host staging + async
-device launch via ``engine.dispatch``), and a completer thread *finalizes*
-it (blocks on the device, scatters rows back to callers). Because XLA
+device launch via ``engine.dispatch``), and completer threads *finalize*
+it (block on the device, scatter rows back to callers). Because XLA
 dispatch is asynchronous, host assembly of batch N+1 overlaps device
 execution of batch N. The in-flight window is bounded
 (``pipeline_depth``): the worker will not cut a new batch while the window
@@ -20,6 +20,17 @@ the device is the bottleneck — and device work is never launched for more
 flushes than the window allows. With ``pipeline_depth=1`` the pipeline
 degenerates to strictly serial flushes (the pre-pipeline behavior); that
 is the default for plain ``run_fn`` engines, which have no async seam.
+
+Completion runs in PER-REPLICA LANES: a multi-replica engine gets one
+completer thread per replica, and every dispatched flush lands in the lane
+of the replica it was routed to (``handle.lane``, stamped by the engine's
+dispatch). Finalize order is preserved *within* a lane — the device
+executes a replica's flushes in dispatch order, so lane order is the only
+order that matters — but one replica's slow finalize no longer
+head-of-line blocks another replica's already-finished flush behind it in
+a global queue. A handle without a lane (run_fn mode, fakes) rides lane 0,
+which with a single-replica engine reproduces the old single-completer
+behavior exactly.
 
 Backpressure is explicit, not emergent: the queue is bounded, and a submit
 against a full queue returns an ``overloaded`` result IMMEDIATELY instead
@@ -163,8 +174,9 @@ class MicroBatcher:
     up to ``max_batch`` rows), waits out the remainder of ``max_latency``
     (measured from the oldest request) for stragglers when the batch is
     not yet full — and only cuts a batch when the in-flight window has a
-    free slot. Dispatched flushes are finalized by the completer thread in
-    dispatch order. ``close()`` drains what is queued, then stops both."""
+    free slot. Dispatched flushes are finalized by per-replica completer
+    lanes, in dispatch order within each lane. ``close()`` drains what is
+    queued, then stops every thread."""
 
     def __init__(
         self,
@@ -203,16 +215,25 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: deque = deque()
-        self._inflight: deque = deque()
+        # completion lanes: one in-flight deque + completer thread per
+        # replica of the INITIAL engine (run_fn mode: one lane). A swap to
+        # an engine with more replicas folds extra replicas onto the
+        # existing lanes (modulo) — correct, just less parallel.
+        if engine is not None:
+            lane_count = max(1, int(getattr(engine, "replica_count", 1) or 1))
+        else:
+            lane_count = 1
+        self._lane_count = lane_count
+        self._lanes = [deque() for _ in range(lane_count)]
         self._window_used = 0  # cut-or-dispatched flushes not yet completed
         self._closed = False
         self._worker_done = False
         self._swaps = 0
-        # the flush the worker/completer is currently working OUTSIDE the
-        # lock, attributed to its engine — with the _inflight queue these
-        # make flights_on() exact, which is what engine retirement waits on
+        # the flush the worker/completers are currently working OUTSIDE the
+        # lock, attributed to its engine — with the lane queues these make
+        # flights_on() exact, which is what engine retirement waits on
         self._dispatching_on = None
-        self._finalizing_on = None
+        self._finalizing_on = [None] * lane_count
 
         # -- counters (read under the lock; exported by metrics()) ----------
         self._submitted: Dict[str, int] = defaultdict(int)
@@ -258,12 +279,16 @@ class MicroBatcher:
         self._worker = threading.Thread(
             target=self._worker_loop, name="micro-batcher", daemon=True
         )
-        self._completer = threading.Thread(
-            target=self._completer_loop, name="micro-batcher-complete",
-            daemon=True,
-        )
+        self._completers = [
+            threading.Thread(
+                target=self._completer_loop, args=(i,),
+                name=f"micro-batcher-complete-{i}", daemon=True,
+            )
+            for i in range(lane_count)
+        ]
         self._worker.start()
-        self._completer.start()
+        for t in self._completers:
+            t.start()
 
     # -- client side --------------------------------------------------------
     def submit(
@@ -330,7 +355,8 @@ class MicroBatcher:
                 self._g_queue.set(0)
             self._cv.notify_all()
         self._worker.join(timeout=10.0)
-        self._completer.join(timeout=10.0)
+        for t in self._completers:
+            t.join(timeout=10.0)
 
     # -- the engine-swap seam (deploy/ reload plane) ------------------------
     @property
@@ -369,11 +395,11 @@ class MicroBatcher:
         finalized. Zero means the engine's last flight has fully drained —
         the retirement condition after a swap."""
         with self._lock:
-            n = sum(1 for ent in self._inflight if ent.engine is engine)
+            n = sum(1 for lane in self._lanes
+                    for ent in lane if ent.engine is engine)
             if self._dispatching_on is engine:
                 n += 1
-            if self._finalizing_on is engine:
-                n += 1
+            n += sum(1 for fin in self._finalizing_on if fin is engine)
             return n
 
     # -- worker side --------------------------------------------------------
@@ -570,9 +596,14 @@ class MicroBatcher:
                          "riders": [r.trace_id for r in live]})
                     TRACER.async_begin("serve.flight", flight_id,
                                        {"kind": live[0].kind, "rows": total})
+                # lane = the replica this flush was routed to (stamped by
+                # the engine's dispatch); run_fn handles and fakes without
+                # one ride lane 0. Modulo guards a swap to a wider engine.
+                lane = getattr(handle, "lane", None)
+                lane = 0 if lane is None else int(lane) % self._lane_count
                 with self._lock:
                     self._stages.add("assemble", time.perf_counter() - t0)
-                    self._inflight.append(
+                    self._lanes[lane].append(
                         _Inflight(live, handle, total, flight_id, engine))
                     self._dispatching_on = None
                     self._cv.notify_all()
@@ -581,15 +612,16 @@ class MicroBatcher:
                 self._worker_done = True
                 self._cv.notify_all()
 
-    def _completer_loop(self) -> None:
+    def _completer_loop(self, lane_idx: int) -> None:
+        lane = self._lanes[lane_idx]
         while True:
             with self._lock:
-                while not self._inflight and not self._worker_done:
+                while not lane and not self._worker_done:
                     self._cv.wait()
-                if not self._inflight:
-                    return  # worker exited and everything is finalized
-                ent = self._inflight.popleft()
-                self._finalizing_on = ent.engine
+                if not lane:
+                    return  # worker exited and this lane is finalized
+                ent = lane.popleft()
+                self._finalizing_on[lane_idx] = ent.engine
             t0 = time.perf_counter()
             try:
                 # finalize on the engine that DISPATCHED this flush — after
@@ -601,7 +633,7 @@ class MicroBatcher:
                                      {"status": "error"})
                 with self._lock:
                     self._errors += len(ent.riders)
-                    self._finalizing_on = None
+                    self._finalizing_on[lane_idx] = None
                 for req in ent.riders:
                     self._c_request["error"](req.kind).inc()
                     req.finish(ServeResult(
@@ -626,7 +658,7 @@ class MicroBatcher:
                 TRACER.async_end("serve.flight", ent.flight_id,
                                  {"status": "ok"})
             with self._lock:
-                self._finalizing_on = None
+                self._finalizing_on[lane_idx] = None
                 self._stages.add("device", t1 - t0)
                 self._stages.add("complete", t2 - t1)
                 self._flushes += 1
@@ -671,6 +703,7 @@ class MicroBatcher:
                 "pipeline": {
                     "depth": self.pipeline_depth,
                     "in_flight": self._window_used,
+                    "lanes": self._lane_count,
                     "mode": "engine" if self._engine is not None else "run_fn",
                     "stage_ms": self._stages.summary_ms(),
                     "stage_occupancy": self._stages.occupancy(),

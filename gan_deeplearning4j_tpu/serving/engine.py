@@ -88,15 +88,20 @@ class _StagingBuf:
 
 class _Flight:
     """One dispatched flush: the in-flight device computation plus what
-    ``finalize`` needs to slice, recycle, and account it."""
+    ``finalize`` needs to slice, recycle, and account it. ``lane`` is the
+    replica the flush was routed to — the batcher's per-replica completion
+    lanes key on it, so one replica's slow finalize never head-of-line
+    blocks another replica's finished work (multi-chunk flights use the
+    first chunk's replica; bulk-lane flights ride lane 0)."""
 
-    __slots__ = ("kind", "total", "parts")
+    __slots__ = ("kind", "total", "parts", "lane")
 
-    def __init__(self, kind: str, total: int, parts: list):
+    def __init__(self, kind: str, total: int, parts: list, lane: int = 0):
         self.kind = kind
         self.total = total
         # parts: (device_out, n_real_rows, staging_buf_or_None, replica_or_None)
         self.parts = parts
+        self.lane = lane
 
 
 class ServingEngine:
@@ -657,7 +662,11 @@ class ServingEngine:
                 raise
             parts.append((out, n, buf, r))
             remaining -= n
-        return _Flight(kind, total, parts)
+        # the flight's completion lane is the replica its FIRST replica-
+        # routed chunk ran on (bulk-lane parts carry no replica); a
+        # bulk-only flight rides lane 0
+        lane = next((r for _, _, _, r in parts if r is not None), 0)
+        return _Flight(kind, total, parts, lane=lane)
 
     def _release(self, kind: str, buf: Optional[_StagingBuf],
                  r: Optional[int]) -> None:

@@ -19,6 +19,11 @@ framework dependency, per the repo's no-new-deps rule). Endpoints:
 - ``POST /admin/reload``  force an immediate reload-plane poll (202;
   ``block=1`` waits for the cycle and answers 200; 409 while a reload is
   in progress or when no reload plane is attached — docs/DEPLOY.md)
+- ``POST /admin/drain``   mark this worker draining (``off=1`` clears):
+  ``/healthz`` reports ``"draining"`` so a fleet router stops routing to
+  it and health probes do not re-admit it, while requests already in the
+  pipeline still finalize normally — the draining-restart handshake
+  (docs/FLEET.md); the worker itself sheds nothing
 - ``GET  /debug/spans``  the span tracer's Chrome trace JSON (Perfetto-
   loadable; empty unless tracing is enabled)
 
@@ -81,6 +86,10 @@ class InferenceService:
         # the reload control plane (deploy.ReloadController), when attached:
         # owns POST /admin/reload and the /healthz "reload" block
         self.reloader = None
+        # draining flag (POST /admin/drain): advisory — the worker keeps
+        # answering, but /healthz stops reporting "ok" so a fleet router
+        # neither routes to it nor re-admits it while its pipeline empties
+        self.draining = False
         if warmup in (True, "sync"):
             engine.warmup()
         elif warmup in ("eager", "background"):
@@ -121,12 +130,16 @@ class InferenceService:
     # -- shared request handler --------------------------------------------
     def healthz(self) -> dict:
         engine = self.engine  # one snapshot — a swap mid-handler is benign
-        if engine.warming:
-            status = "warming"
-        elif engine.warm_failed:
+        if engine.warm_failed:
             # a failed background warmup must NOT look healthy: the ladder
             # is not compiled, so requests would pay serve-time compiles
             status = "error"
+        elif self.draining:
+            # draining outranks warming/ok: a router must neither route to
+            # nor re-admit a worker that is being rotated out
+            status = "draining"
+        elif engine.warming:
+            status = "warming"
         else:
             status = "ok"
         body = {
@@ -154,6 +167,7 @@ class InferenceService:
         return {
             **self.batcher.metrics(),
             "generation": engine.generation,
+            "draining": self.draining,
             "engine": engine.stats(),
             "compile_counts": engine.compile_counts,
         }
@@ -235,6 +249,12 @@ class InferenceService:
             return self._debug_trace(params)
         if method == "POST" and path == "/admin/reload":
             return self._admin_reload(params)
+        if method == "POST" and path == "/admin/drain":
+            # the fleet manager's draining-restart handshake: mark (or with
+            # off=1 clear) drain, answer the resulting health state — the
+            # caller then watches /metrics until the pipeline empties
+            self.draining = params.get("off", ["0"])[0] in ("0", "", "false")
+            return 200, {"status": "ok", "draining": self.draining}
         if method == "POST" and path.startswith("/v1/"):
             kind = path[len("/v1/"):]
             # one engine snapshot for the whole request: a swap between the

@@ -1,0 +1,495 @@
+#!/usr/bin/env python
+"""Fleet drill — real faults against the multi-process serving plane.
+
+The executable form of docs/FLEET.md's invariants, against real
+subprocesses under closed-loop load through the router:
+
+1. **boot** — an untrained experiment seeds serving generation 0 into a
+   fresh store; ``python -m gan_deeplearning4j_tpu.fleet`` spawns N
+   workers from it plus the router, and the drill waits until every
+   worker is warm and routable. Closed-loop client threads then hammer
+   the ROUTER's ``/v1/sample`` for the rest of the drill.
+2. **SIGKILL** — one worker is hard-killed. The router ejects it (or the
+   manager relaunches it first — whichever signal lands first), requests
+   in flight there are retried on another worker under the budget, and
+   the slot must come back routable with a fresh process.
+3. **SIGSTOP** — one worker is hung, not killed. Per-request timeouts
+   plus the passive breaker must eject it; after SIGCONT the half-open
+   probe must RE-ADMIT it without a restart (the hang was transient).
+4. **rolling upgrade** — a supervisor segment trains and publishes newer
+   serving generations on cadence; the fleet must admit them through ONE
+   sidecar canary decision each and roll workers one at a time, ending
+   converged on the trainer's final generation.
+5. **poison** — a digest-valid but quality-garbage generation is
+   published. The fleet admission gate must reject it, quarantine it
+   through the store (fleet-wide, once), and no worker may ever serve it.
+6. **ledger** — every submitted request got exactly one answer, zero
+   lost, client-visible 503s bounded by the router's own honest-503
+   counters (the retry-budget contract), zero 5xx, and every worker's
+   ``serve_compile_counts`` stays 0 (re-routing cannot break the
+   bounded-compile invariant).
+
+Results land as a BENCH-style JSON (``--output``; ``--record TAG`` also
+writes ``BENCH_fleet_<TAG>.json`` at the repo root). Exit status is
+nonzero on any invariant breach, so CI gates on the drill directly
+(``scripts/tpu_campaign.sh`` runs ``--smoke`` CPU-pinned after the
+reload drill).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from resilience_drill import make_workload  # noqa: E402 (scripts/ sibling)
+from reload_drill import (  # noqa: E402
+    free_port,
+    http_json,
+    poison_newest,
+    seed_bundle,
+)
+
+FLEET = [sys.executable, "-m", "gan_deeplearning4j_tpu.fleet"]
+TRAINER = [sys.executable, "-m", "gan_deeplearning4j_tpu.resilience"]
+
+# Subprocesses run with the persistent XLA compilation cache OFF for the
+# same reason the resilience/reload drills' workers do (XLA:CPU AOT
+# loader hazard): a cache-poisoned segfault must not masquerade as a
+# fleet failure.
+_ENV = {**os.environ, "GDT_COMPILATION_CACHE": "off"}
+
+
+def log(msg: str) -> None:
+    print(f"[fleet-drill] {msg}", flush=True)
+
+
+class LoadGenerator:
+    """Closed-loop /v1/sample clients against the ROUTER. Every attempt
+    is accounted: ok (200), shed (503), error (other status), or lost
+    (no HTTP answer at all) — the exactly-one-answer ledger. The client
+    timeout leaves room for the router's full retry schedule, so a slow
+    answer is never misread as a lost one."""
+
+    def __init__(self, base: str, z_size: int, threads: int = 2,
+                 timeout: float = 30.0):
+        self.base = base
+        self.z_size = z_size
+        self.timeout = timeout
+        self.stop = threading.Event()
+        self.counts = {"sent": 0, "ok": 0, "shed": 0, "error": 0, "lost": 0}
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+
+    def _run(self, tid: int) -> None:
+        rng = np.random.default_rng(2000 + tid)
+        while not self.stop.is_set():
+            rows = (rng.random((int(rng.integers(1, 4)), self.z_size),
+                               dtype=np.float32) * 2.0 - 1.0)
+            with self._lock:
+                self.counts["sent"] += 1
+            status, _ = http_json(
+                "POST", f"{self.base}/v1/sample", {"data": rows.tolist()},
+                timeout=self.timeout)
+            with self._lock:
+                if status is None:
+                    self.counts["lost"] += 1
+                elif status == 200:
+                    self.counts["ok"] += 1
+                elif status == 503:
+                    self.counts["shed"] += 1
+                else:
+                    self.counts["error"] += 1
+            time.sleep(0.005)  # keep 2 shared cores breathable
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def finish(self) -> dict:
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=self.timeout + 5.0)
+        return dict(self.counts)
+
+
+class FleetMonitor:
+    """Polls the router's /healthz continuously, recording every (worker,
+    generation) pair observed and the routable-count envelope — the
+    drill's ground truth for 'the poison was never served' and 'the
+    ejection actually happened'."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.stop = threading.Event()
+        self.generations_served: set = set()
+        self.min_routable: int = 10**9
+        self.max_routable: int = 0
+        self.last: dict = {}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        while not self.stop.is_set():
+            status, body = http_json("GET", f"{self.base}/healthz",
+                                     timeout=5.0)
+            if status == 200 and body:
+                self.last = body
+                self.min_routable = min(self.min_routable,
+                                        body.get("routable", 0))
+                self.max_routable = max(self.max_routable,
+                                        body.get("routable", 0))
+                for w in body.get("workers", []):
+                    if w.get("routable") and w.get("generation") is not None:
+                        self.generations_served.add(w["generation"])
+            time.sleep(0.1)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def finish(self) -> None:
+        self.stop.set()
+        self._thread.join(timeout=10.0)
+
+
+def fleet_health(base: str):
+    _, body = http_json("GET", f"{base}/healthz", timeout=5.0)
+    return body or {}
+
+
+def wait_for(predicate, deadline_s: float, what: str, interval: float = 0.2):
+    """Poll until predicate() is truthy; returns its value (None on
+    timeout, logged — the caller's invariant records the breach)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    log(f"TIMEOUT waiting for {what} ({deadline_s:.0f}s)")
+    return None
+
+
+def worker_by_id(health: dict, worker_id: str) -> dict:
+    for w in (health.get("fleet") or {}).get("workers", []):
+        if w["id"] == worker_id:
+            return w
+    return {}
+
+
+def router_worker(health: dict, worker_id: str) -> dict:
+    for w in health.get("workers", []):
+        if w["id"] == worker_id:
+            return w
+    return {}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="campaign/CI shape: 2 workers, 12 trainer steps")
+    p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--total-steps", type=int, default=None)
+    p.add_argument("--serve-every", type=int, default=None)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--keep-last", type=int, default=10)
+    p.add_argument("--workdir", default=None,
+                   help="keep work files here instead of a temp dir")
+    p.add_argument("--output", default=None, metavar="PATH")
+    p.add_argument("--record", default=None, metavar="TAG",
+                   help="also write BENCH_fleet_<TAG>.json at the repo root")
+    args = p.parse_args(argv)
+
+    n_workers = args.workers or (2 if args.smoke else 3)
+    total = args.total_steps or (12 if args.smoke else 24)
+    serve_every = args.serve_every or 6
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_drill_")
+    cleanup = args.workdir is None
+    os.makedirs(workdir, exist_ok=True)
+    serve_store = os.path.join(workdir, "store_serve")
+    train_store = os.path.join(workdir, "store_train")
+
+    workload = make_workload(workdir, args.seed)
+    results: dict = {}
+    invariants: dict = {}
+    fleet = trainer = None
+    load = monitor = None
+    router_port = free_port()
+    worker_ports = [free_port() for _ in range(n_workers)]
+    base = f"http://127.0.0.1:{router_port}"
+
+    try:
+        # -- phase 0: seed + boot the fleet -----------------------------
+        gen0 = seed_bundle(workload, serve_store, args.keep_last)
+        log(f"seeded serving generation {gen0}")
+        fleet_log = open(os.path.join(workdir, "fleet.log"), "w")
+        fleet = subprocess.Popen(
+            FLEET + [
+                "--store", serve_store,
+                "--workers", str(n_workers),
+                "--port", str(router_port),
+                "--worker-ports", ",".join(str(x) for x in worker_ports),
+                "--log-dir", workdir,
+                "--poll", "0.5", "--probe-interval", "0.15",
+                "--request-timeout", "3.0",
+                "--retry-ratio", "0.5", "--retry-burst", "10",
+                "--eject-failures", "3", "--reopen-after", "0.5",
+                "--drain-timeout", "15", "--warm-timeout", "240",
+                "--hang-restart", "30",
+                "--buckets", "1,8", "--replicas", "1",
+                "--max-latency", "0.002",
+                "--canary-data", workload["data"],
+                "--canary-samples", "32",
+                "--canary-fid-ratio", "1.1", "--canary-fid-slack", "0.5",
+                "--boot-wait", "60", "--telemetry",
+            ],
+            cwd=_REPO, env=_ENV, stdout=fleet_log, stderr=fleet_log,
+        )
+        health = wait_for(
+            lambda: (fleet.poll() is None
+                     and (h := fleet_health(base)).get("routable")
+                     == n_workers and h.get("generation") == gen0 and h),
+            420.0, "fleet healthy on the seed generation")
+        if not health:
+            log(f"fleet never became healthy (rc={fleet.poll()})")
+            return 2
+        z_size = 4  # the drill workload's latent width (make_workload)
+        log(f"fleet healthy on {base}: {n_workers} workers, "
+            f"generation {gen0}")
+        monitor = FleetMonitor(base)
+        monitor.start()
+        load = LoadGenerator(base, z_size)
+        load.start()
+        time.sleep(1.0)  # let traffic establish before the first fault
+
+        # -- phase 1: SIGKILL a worker ----------------------------------
+        victim = worker_by_id(health, "w0")
+        log(f"SIGKILL worker w0 (pid {victim.get('pid')})")
+        os.kill(victim["pid"], signal.SIGKILL)
+        recovered = wait_for(
+            lambda: ((h := fleet_health(base)).get("routable") == n_workers
+                     and worker_by_id(h, "w0").get("restarts", 0) >= 1
+                     and worker_by_id(h, "w0").get("pid")
+                     not in (None, victim["pid"]) and h),
+            300.0, "SIGKILLed worker relaunched and re-admitted")
+        results["sigkill"] = {
+            "old_pid": victim.get("pid"),
+            "new_pid": worker_by_id(recovered or {}, "w0").get("pid"),
+            "restarts": worker_by_id(recovered or {}, "w0").get("restarts"),
+            "counts_at_recovery": dict(load.counts),
+        }
+        invariants["sigkill_worker_relaunched"] = bool(recovered)
+
+        # -- phase 2: SIGSTOP (hang) + half-open re-admission -----------
+        health = fleet_health(base)
+        hung = worker_by_id(health, "w1")
+        restarts_before = hung.get("restarts", 0)
+        log(f"SIGSTOP worker w1 (pid {hung.get('pid')})")
+        os.kill(hung["pid"], signal.SIGSTOP)
+        try:
+            ejected = wait_for(
+                lambda: not router_worker(fleet_health(base),
+                                          "w1").get("routable", True),
+                120.0, "hung worker ejected")
+        finally:
+            os.kill(hung["pid"], signal.SIGCONT)
+        log("SIGCONT sent — waiting for half-open re-admission")
+        readmitted = wait_for(
+            lambda: ((h := fleet_health(base)).get("routable") == n_workers
+                     and router_worker(h, "w1").get("routable") and h),
+            120.0, "hung worker re-admitted")
+        restarts_after = worker_by_id(readmitted or {}, "w1").get(
+            "restarts", -1)
+        results["sigstop"] = {
+            "pid": hung.get("pid"),
+            "ejected": bool(ejected),
+            "readmitted": bool(readmitted),
+            "restarts_before": restarts_before,
+            "restarts_after": restarts_after,
+            "counts_at_recovery": dict(load.counts),
+        }
+        invariants["hung_worker_ejected"] = bool(ejected)
+        invariants["hung_worker_readmitted_without_restart"] = (
+            bool(readmitted) and restarts_after == restarts_before)
+
+        # -- phase 3: rolling generation upgrades -----------------------
+        trainer_log = open(os.path.join(workdir, "trainer.log"), "w")
+        trainer = subprocess.Popen(
+            TRAINER + [
+                "--config", workload["config"], "--data", workload["data"],
+                "--store", train_store,
+                "--serve-store", serve_store,
+                "--total-steps", str(total),
+                "--publish-every", str(serve_every),
+                "--serve-publish-every", str(serve_every),
+                "--keep-last", str(args.keep_last),
+                "--summary", os.path.join(workdir, "trainer_summary.json"),
+            ],
+            cwd=_REPO, env=_ENV, stdout=trainer_log, stderr=trainer_log,
+        )
+        try:
+            trainer.wait(timeout=600.0)
+        except subprocess.TimeoutExpired:
+            trainer.kill()
+            log("trainer hung — killed")
+        try:
+            with open(os.path.join(workdir, "trainer_summary.json")) as fh:
+                trainer_summary = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            trainer_summary = {}
+        final_gen = trainer_summary.get("final_serve_generation")
+        log(f"trainer done rc={trainer.returncode}, "
+            f"final serve generation {final_gen}")
+        converged = wait_for(
+            lambda: ((h := fleet_health(base)).get("generation") == final_gen
+                     and h.get("routable") == n_workers
+                     and (h.get("fleet") or {}).get("state") == "idle"
+                     and h),
+            600.0, "fleet converged on the trainer's final generation")
+        fleet_state = (converged or fleet_health(base)).get("fleet") or {}
+        results["rolling_upgrade"] = {
+            "trainer_rc": trainer.returncode,
+            "final_serve_generation": final_gen,
+            "fleet_generation": (converged or {}).get("generation"),
+            "rolls": fleet_state.get("rolls"),
+            "rejected": fleet_state.get("rejected"),
+            "counts_at_convergence": dict(load.counts),
+        }
+        invariants["fleet_converged_to_final_generation"] = bool(converged)
+        invariants["rolling_upgrades_ge_1"] = (
+            (fleet_state.get("rolls") or 0) >= 1)
+
+        # -- phase 4: poisoned generation, one fleet-wide decision ------
+        rejected_before = fleet_state.get("rejected", 0)
+        poison = poison_newest(serve_store, args.keep_last)
+        log(f"published poisoned generation {poison}")
+        rejected = wait_for(
+            lambda: (((h := fleet_health(base)).get("fleet") or {})
+                     .get("rejected", 0) > rejected_before and h),
+            420.0, "fleet rejected the poisoned generation")
+        from gan_deeplearning4j_tpu.resilience import CheckpointStore
+
+        entry = CheckpointStore(serve_store,
+                                keep_last=args.keep_last).entry(poison)
+        after = fleet_health(base)
+        results["poison"] = {
+            "generation": poison,
+            "ledger_status": entry.get("status"),
+            "quarantine_reason": entry.get("reason"),
+            "fleet_generation_after": after.get("generation"),
+            "rejected": (after.get("fleet") or {}).get("rejected"),
+        }
+        invariants["poison_rejected_once_fleet_wide"] = bool(rejected)
+        invariants["poison_quarantined_in_store"] = (
+            entry.get("status") == "quarantined"
+            and "canary" in (entry.get("reason") or ""))
+        invariants["poison_never_served"] = (
+            poison not in monitor.generations_served
+            and after.get("generation") == final_gen)
+
+        # -- phase 5: ledgers -------------------------------------------
+        counts = load.finish()
+        load = None
+        monitor.finish()
+        _, router_metrics = http_json("GET", f"{base}/metrics", timeout=5.0)
+        router_metrics = router_metrics or {}
+        results["requests"] = counts
+        results["router"] = {
+            k: router_metrics.get(k)
+            for k in ("proxied", "ok", "error", "retries",
+                      "budget_exhausted", "no_worker", "attempts_exhausted",
+                      "ejections", "retry_budget_tokens")
+        }
+        results["generations_served"] = sorted(monitor.generations_served)
+        results["routable_envelope"] = [monitor.min_routable,
+                                        monitor.max_routable]
+        invariants["exactly_one_answer_zero_lost"] = (
+            counts["lost"] == 0
+            and counts["ok"] + counts["shed"] + counts["error"]
+            == counts["sent"])
+        # the retry-budget contract: every client-visible 503 is one of
+        # the router's honest-503 paths, and no request got a 5xx the
+        # router could not account for
+        honest_503s = ((router_metrics.get("budget_exhausted") or 0)
+                       + (router_metrics.get("no_worker") or 0)
+                       + (router_metrics.get("attempts_exhausted") or 0))
+        invariants["errors_bounded_by_retry_budget"] = (
+            counts["error"] == 0 and counts["shed"] <= honest_503s)
+        # bounded-compile through re-routing: no worker ever paid a
+        # serve-time compile (scraped directly, not via the router)
+        serve_compiles = {}
+        for port in worker_ports:
+            _, m = http_json("GET", f"http://127.0.0.1:{port}/metrics",
+                             timeout=5.0)
+            if m:
+                serve_compiles[str(port)] = (m.get("engine") or {}).get(
+                    "serve_compile_counts", {})
+        results["serve_compile_counts"] = serve_compiles
+        invariants["no_serve_time_compiles"] = bool(serve_compiles) and all(
+            all(v == 0 for v in counts_.values())
+            for counts_ in serve_compiles.values())
+    finally:
+        if load is not None:
+            load.finish()
+        if monitor is not None and not monitor.stop.is_set():
+            monitor.finish()
+        for proc in (trainer, fleet):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=20.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    # -- verdict ---------------------------------------------------------
+    ok = bool(invariants) and all(invariants.values())
+    payload = {
+        "bench": "fleet_drill",
+        "config": {
+            "workers": n_workers,
+            "total_steps": total,
+            "serve_publish_every": serve_every,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "results": results,
+        "invariants": invariants,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.output:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(os.path.join(_REPO, f"BENCH_fleet_{args.record}.json"),
+                  "w") as fh:
+            fh.write(text + "\n")
+    if cleanup and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        log(f"INVARIANT BREACH — work files kept at {workdir}")
+    for name, good in sorted(invariants.items()):
+        log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

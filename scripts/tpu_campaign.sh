@@ -71,6 +71,17 @@ if ! JAX_PLATFORMS=cpu timeout 900 python scripts/reload_drill.py --smoke \
   echo "$(date +%H:%M:%S) reload drill smoke failed — campaign aborted (see reload_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Fleet smoke (CPU, 2 workers + router, real SIGKILL/SIGSTOP/rolling
+# upgrade/poison): the campaign's artifacts feed a multi-process fleet —
+# refuse to start if exactly-one-answer, retry-budget bounding, half-open
+# re-admission, rolling convergence, or fleet-wide quarantine regressed
+# (enforced by the drill's own exit code). Pinned to CPU so it never
+# touches the chip.
+if ! JAX_PLATFORMS=cpu timeout 1800 python scripts/fleet_drill.py --smoke \
+    --output artifacts/fleet_smoke.json > fleet_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) fleet drill smoke failed — campaign aborted (see fleet_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

@@ -1708,6 +1708,112 @@ class TestSwapSeamUnguardedAccess:
 
 
 # ===========================================================================
+# JG017 — blocking network call without an explicit timeout
+# ===========================================================================
+
+class TestUnboundedNetworkCall:
+    def test_true_positive_urlopen_without_timeout(self):
+        # the fleet hazard: a health probe with no timeout wedges the
+        # health loop behind the hung worker it was meant to eject
+        r = run(
+            "import urllib.request\n"
+            "def probe(url):\n"
+            "    with urllib.request.urlopen(url) as resp:\n"
+            "        return resp.read()\n"
+        )
+        assert codes(r) == ["JG017"]
+        assert "timeout" in r.active[0].message
+
+    def test_true_positive_aliased_import_still_caught(self):
+        r = run(
+            "from urllib.request import urlopen as fetch\n"
+            "def probe(url):\n"
+            "    return fetch(url).read()\n"
+        )
+        assert codes(r) == ["JG017"]
+
+    def test_true_positive_http_client_connection(self):
+        r = run(
+            "import http.client\n"
+            "def proxy(host, port):\n"
+            "    conn = http.client.HTTPConnection(host, port)\n"
+            "    conn.request('GET', '/healthz')\n"
+            "    return conn.getresponse().read()\n"
+        )
+        assert codes(r) == ["JG017"]
+
+    def test_true_positive_socket_create_connection(self):
+        r = run(
+            "import socket\n"
+            "def dial(addr):\n"
+            "    return socket.create_connection(addr)\n"
+        )
+        assert codes(r) == ["JG017"]
+
+    def test_true_negative_timeout_keyword(self):
+        # the corrected idiom every fleet/router/watcher path uses
+        r = run(
+            "import http.client\n"
+            "import urllib.request\n"
+            "def probe(url, host):\n"
+            "    with urllib.request.urlopen(url, timeout=2.0) as resp:\n"
+            "        body = resp.read()\n"
+            "    conn = http.client.HTTPConnection(host, 80, timeout=5.0)\n"
+            "    conn.close()\n"
+            "    return body\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_positional_timeout_slot(self):
+        r = run(
+            "import socket\n"
+            "import urllib.request\n"
+            "def dial(addr, url):\n"
+            "    s = socket.create_connection(addr, 3.0)\n"
+            "    return urllib.request.urlopen(url, None, 5.0), s\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_bind_shapes_not_flagged(self):
+        # a bare socket() that binds/listens (free_port) never dials out
+        r = run(
+            "import socket\n"
+            "def free_port():\n"
+            "    with socket.socket() as s:\n"
+            "        s.bind(('127.0.0.1', 0))\n"
+            "        return s.getsockname()[1]\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_unrelated_local_helper(self):
+        # a project-local urlopen helper is not the stdlib entry point
+        r = run(
+            "from myproj.http import urlopen\n"
+            "def probe(url):\n"
+            "    return urlopen(url)\n"
+        )
+        assert codes(r) == []
+
+    def test_skips_test_modules(self):
+        r = run(
+            "import urllib.request\n"
+            "def test_probe(url):\n"
+            "    return urllib.request.urlopen(url)\n",
+            path="tests/test_probe.py",
+        )
+        assert codes(r) == []
+
+    def test_suppression_applies(self):
+        r = run(
+            "import urllib.request\n"
+            "def probe(url):\n"
+            "    return urllib.request.urlopen(url)  # jaxlint: disable=JG017\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG017"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

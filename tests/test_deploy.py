@@ -470,6 +470,130 @@ class TestCanaryGate:
 
 
 # ===========================================================================
+# the fleet-admission seam (sidecar probes share the gate's decision)
+# ===========================================================================
+
+class TestFleetAdmissionSeam:
+    def test_compare_probes_is_the_gate_decision(self):
+        from gan_deeplearning4j_tpu.deploy import compare_probes
+
+        t = CanaryThresholds(fid_ratio_max=1.5, fid_slack=1.0,
+                             accuracy_drop_max=0.05)
+        good = compare_probes({"fid": 10.0, "accuracy": 0.9},
+                              {"fid": 10.0, "accuracy": 0.9}, t)
+        assert good.passed and good.reason == "ok"
+        fid_blown = compare_probes({"fid": 100.0, "accuracy": 0.9},
+                                   {"fid": 10.0, "accuracy": 0.9}, t)
+        assert not fid_blown.passed and "fid" in fid_blown.reason
+        acc_drop = compare_probes({"fid": 10.0, "accuracy": 0.80},
+                                  {"fid": 10.0, "accuracy": 0.90}, t)
+        assert not acc_drop.passed and "accuracy" in acc_drop.reason
+        # NaN fails closed, exactly like the in-process gate
+        nan = compare_probes({"fid": float("nan"), "accuracy": None},
+                             {"fid": 10.0, "accuracy": None}, t)
+        assert not nan.passed
+        # accuracy is skipped when either side has none
+        no_acc = compare_probes({"fid": 10.0, "accuracy": None},
+                                {"fid": 10.0, "accuracy": 0.9}, t)
+        assert no_acc.passed
+
+    def test_gate_evaluate_agrees_with_compare_probes(self):
+        # the refactor seam: an injected-probe gate and a bare
+        # compare_probes over the same numbers must decide identically
+        from gan_deeplearning4j_tpu.deploy import compare_probes
+
+        probes = {"cand": {"fid": 30.0, "accuracy": None},
+                  "inc": {"fid": 10.0, "accuracy": None}}
+        gate = CanaryGate(np.zeros((8, FEAT), np.float32), num_samples=8,
+                          thresholds=CanaryThresholds(fid_ratio_max=1.5,
+                                                      fid_slack=1.0),
+                          probe=lambda e: probes[e])
+        via_gate = gate.evaluate("cand", "inc")
+        direct = compare_probes(probes["cand"], probes["inc"],
+                                CanaryThresholds(fid_ratio_max=1.5,
+                                                 fid_slack=1.0))
+        assert via_gate.passed == direct.passed == False  # noqa: E712
+        assert via_gate.reason == direct.reason
+
+    def test_dis_feature_fid_path_round_trips(self, tmp_path):
+        """--canary-feature dis_features end to end: the checkpointed
+        classifier's feature vertex embeds both probe sides, and the gate
+        decides on FID in that space."""
+        from gan_deeplearning4j_tpu.deploy import feature_fn_from_checkpoint
+
+        bundle = str(tmp_path / "bundle")
+        write_bundle(bundle, generation=0)
+        fn = feature_fn_from_checkpoint(os.path.join(bundle, "cv.zip"),
+                                        "feat_1")
+        rows = np.random.default_rng(0).random((8, FEAT), dtype=np.float32)
+        feats = np.asarray(fn(rows))
+        assert feats.shape == (8, HIDDEN)  # the feature vertex's width
+        np.testing.assert_allclose(np.asarray(fn(rows)), feats)  # pinned
+        # identical engines probed through the dis-feature space pass the
+        # gate with identical FIDs — the full round trip
+        engine = ServingEngine.from_bundle(bundle)
+        gate = CanaryGate(rows, num_samples=8, feature_fn=fn,
+                          thresholds=CanaryThresholds(fid_ratio_max=1.05,
+                                                      fid_slack=1e-6))
+        decision = gate.evaluate(engine, engine)
+        assert decision.passed
+        assert decision.candidate["fid"] == pytest.approx(
+            decision.incumbent["fid"])
+
+    def test_unknown_feature_vertex_rejected(self, tmp_path):
+        from gan_deeplearning4j_tpu.deploy import feature_fn_from_checkpoint
+
+        bundle = str(tmp_path / "bundle")
+        write_bundle(bundle, generation=0)
+        with pytest.raises(ValueError, match="not a vertex"):
+            feature_fn_from_checkpoint(os.path.join(bundle, "cv.zip"),
+                                       "nope")
+
+    def test_cli_maps_bundle_to_dis_feature_space(self, tmp_path):
+        # the manifest resolution behind the serving CLI and the sidecar
+        # probe: a bundle with a classifier + feature vertex resolves,
+        # one without maps to None (raw)
+        from gan_deeplearning4j_tpu.deploy.canary import classifier_from_bundle
+
+        bundle = str(tmp_path / "bundle")
+        write_bundle(bundle, generation=0)
+        resolved = classifier_from_bundle(bundle)
+        assert resolved == (os.path.join(bundle, "cv.zip"), "feat_1")
+        bare = str(tmp_path / "bare")
+        os.makedirs(bare)
+        with open(os.path.join(bare, "serving.json"), "w") as fh:
+            json.dump({"format_version": 1, "generator": "gen.zip"}, fh)
+        assert classifier_from_bundle(bare) is None
+
+    def test_sidecar_probe_cli_round_trips(self, tmp_path):
+        """The fleet manager's sidecar: ``python -m
+        gan_deeplearning4j_tpu.deploy probe`` prints one JSON probe line
+        for a bundle, in the dis-feature space of a reference bundle."""
+        bundle = str(tmp_path / "bundle")
+        write_bundle(bundle, generation=3)
+        rng = np.random.default_rng(1)
+        data = str(tmp_path / "data.npz")
+        np.savez(data,
+                 features=rng.random((16, FEAT), dtype=np.float32),
+                 labels=np.eye(CLASSES, dtype=np.float32)[
+                     rng.integers(0, CLASSES, 16)])
+        out = subprocess.run(
+            [sys.executable, "-m", "gan_deeplearning4j_tpu.deploy",
+             "probe", "--bundle", bundle, "--data", data,
+             "--samples", "8", "--feature", "dis_features"],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "GDT_COMPILATION_CACHE": "off"},
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        probe = json.loads(out.stdout.strip().splitlines()[-1])
+        assert np.isfinite(probe["fid"])
+        assert probe["accuracy"] is not None
+        assert probe["generation"] == 3
+        assert probe["feature"] == "dis_features"
+
+
+# ===========================================================================
 # reload controller — end to end against real engines
 # ===========================================================================
 

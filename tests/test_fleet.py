@@ -1,0 +1,776 @@
+"""fleet/ subsystem tests: circuit-breaker state machine, retry budget,
+router proxying + ejection + re-admission against fake HTTP workers, the
+draining-restart handshake, and the subprocess fleet drill (slow).
+
+The fake workers are real stdlib HTTP servers with scripted behavior
+(answer / die mid-request / shed / hang), so every router path — p2c
+pick, retry, breaker trip, half-open probe — runs over real sockets
+without a single jax import.
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.fleet import (
+    CircuitBreaker,
+    FleetManager,
+    FleetRouter,
+    RetryBudget,
+    make_router_server,
+)
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ===========================================================================
+# fake workers
+# ===========================================================================
+
+class _Behavior:
+    """Scripted worker behavior, mutable mid-test."""
+
+    def __init__(self):
+        self.health = "ok"
+        self.generation = 1
+        self.queue_depth = 0
+        self.in_flight = 0
+        self.mode = "ok"  # ok | die | shed
+        self.draining = False
+        self.lock = threading.Lock()
+        self.hits = 0  # /v1 requests that reached this worker
+
+
+class _FakeWorkerHandler(BaseHTTPRequestHandler):
+    behavior: _Behavior = None  # bound per spawn
+
+    def _send(self, code, body):
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        b = self.behavior
+        if self.path.startswith("/healthz"):
+            status = "draining" if b.draining else b.health
+            self._send(200, {"status": status, "generation": b.generation})
+        else:
+            self._send(200, {
+                "queue_depth": b.queue_depth,
+                "generation": b.generation,
+                "draining": b.draining,
+                "pipeline": {"in_flight": b.in_flight},
+                "engine": {"serve_compile_counts": {"sample": 0}},
+            })
+
+    def do_POST(self):  # noqa: N802
+        b = self.behavior
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        if self.path.startswith("/admin/drain"):
+            b.draining = True
+            self._send(200, {"status": "ok", "draining": True})
+            return
+        with b.lock:
+            b.hits += 1
+        if b.mode == "die":
+            # the mid-request death shape: the connection drops with no
+            # response bytes — the client sees a reset/BadStatusLine
+            self.close_connection = True
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return
+        if b.mode == "shed":
+            self._send(503, {"status": "overloaded", "error": "queue full"})
+            return
+        self._send(200, {"status": "ok", "data": [[1.0, 2.0]]})
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def spawn_worker():
+    servers = []
+
+    def spawn():
+        behavior = _Behavior()
+        handler = type("BoundFake", (_FakeWorkerHandler,),
+                       {"behavior": behavior})
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        return behavior, srv.server_address[1]
+
+    yield spawn
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _router(**kw):
+    kw.setdefault("request_timeout", 2.0)
+    kw.setdefault("backoff_base", 0.005)
+    kw.setdefault("backoff_max", 0.01)
+    return FleetRouter(**kw)
+
+
+def _post_sample(router):
+    return router.handle("POST", "/v1/sample",
+                         json.dumps({"data": [[0.5]]}).encode())
+
+
+# ===========================================================================
+# the circuit breaker
+# ===========================================================================
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestCircuitBreaker:
+    def test_init_requires_probe_admission(self):
+        br = CircuitBreaker(clock=FakeClock())
+        assert br.state == "init" and not br.routable
+        assert br.probe_due()
+        assert br.probe_result(True) == "admitted"
+        assert br.routable
+
+    def test_init_probe_failure_stays_init(self):
+        # a warming worker is not FAILING, it is not ready yet — keep
+        # probing, never back off
+        br = CircuitBreaker(clock=FakeClock())
+        br.probe_result(False)
+        assert br.state == "init" and br.probe_due()
+
+    def test_consecutive_failures_trip(self):
+        br = CircuitBreaker(consecutive_failures=3, clock=FakeClock())
+        br.probe_result(True)
+        assert br.record(False) is None
+        assert br.record(False) is None
+        assert br.record(False) == "tripped"
+        assert br.state == "open" and not br.routable
+
+    def test_success_resets_the_streak(self):
+        br = CircuitBreaker(consecutive_failures=3, clock=FakeClock())
+        br.probe_result(True)
+        br.record(False)
+        br.record(False)
+        br.record(True)
+        assert br.record(False) is None  # streak restarted
+        assert br.state == "closed"
+
+    def test_error_rate_trips_despite_interleaved_successes(self):
+        # the flaky-worker path: never 3 in a row, but 60% failing
+        br = CircuitBreaker(consecutive_failures=10, error_rate=0.5,
+                            rate_window=10, rate_min_samples=10,
+                            clock=FakeClock())
+        br.probe_result(True)
+        tripped = None
+        for i in range(20):
+            tripped = tripped or br.record(i % 5 == 0)  # 80% failures
+        assert tripped == "tripped"
+
+    def test_half_open_single_probe_readmission(self):
+        clock = FakeClock()
+        br = CircuitBreaker(consecutive_failures=1, reopen_after=5.0,
+                            clock=clock)
+        br.probe_result(True)
+        br.record(False)
+        assert br.state == "open" and not br.probe_due()
+        clock.now = 5.1
+        assert br.state == "half_open" and br.probe_due()
+        assert not br.routable  # half-open is probe-only, never routable
+        assert br.probe_result(True) == "admitted"
+        assert br.routable
+
+    def test_half_open_failure_doubles_backoff(self):
+        clock = FakeClock()
+        br = CircuitBreaker(consecutive_failures=1, reopen_after=1.0,
+                            reopen_max=30.0, clock=clock)
+        br.probe_result(True)
+        br.record(False)
+        clock.now = 1.1
+        assert br.state == "half_open"
+        br.probe_result(False)
+        assert br.state == "open"
+        clock.now = 2.1  # 1.0s after the failed probe: doubled, not due
+        assert br.state == "open"
+        clock.now = 3.2
+        assert br.state == "half_open"
+
+    def test_outcomes_while_open_do_not_re_trip(self):
+        clock = FakeClock()
+        br = CircuitBreaker(consecutive_failures=1, reopen_after=10.0,
+                            clock=clock)
+        br.probe_result(True)
+        br.record(False)
+        trips = br.trips
+        br.record(False)
+        br.record(False)
+        assert br.trips == trips
+
+    def test_reset_demands_re_admission(self):
+        br = CircuitBreaker(clock=FakeClock())
+        br.probe_result(True)
+        br.reset()
+        assert br.state == "init" and not br.routable
+
+
+class TestRetryBudget:
+    def test_spend_to_exhaustion(self):
+        b = RetryBudget(ratio=0.0, burst=2)
+        assert b.spend() and b.spend()
+        assert not b.spend()
+
+    def test_deposit_caps_at_burst(self):
+        b = RetryBudget(ratio=0.5, burst=2)
+        for _ in range(10):
+            b.deposit()
+        assert b.tokens == 2.0
+        assert b.spend() and b.spend() and not b.spend()
+        b.deposit()  # 0.5 tokens: not enough for a whole retry
+        assert not b.spend()
+        b.deposit()
+        assert b.spend()
+
+
+# ===========================================================================
+# the router (edge cases from the satellite checklist)
+# ===========================================================================
+
+class TestRouterProxy:
+    def test_round_trip_and_p2c_distribution(self, spawn_worker):
+        b1, p1 = spawn_worker()
+        b2, p2 = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        for _ in range(20):
+            status, payload = _post_sample(r)
+            assert status == 200
+            assert json.loads(payload)["data"] == [[1.0, 2.0]]
+        # p2c with equal load must not starve either worker
+        assert b1.hits > 0 and b2.hits > 0
+        assert r.metrics()["ok"] == 20
+
+    def test_worker_dies_mid_request_client_still_gets_one_answer(
+            self, spawn_worker):
+        dying, p1 = spawn_worker()
+        healthy, p2 = spawn_worker()
+        dying.mode = "die"
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        answered = 0
+        for _ in range(12):
+            status, payload = _post_sample(r)
+            assert status == 200, payload  # every request got ONE answer
+            answered += 1
+        assert answered == 12
+        m = r.metrics()
+        # the deaths were absorbed by retries, each consuming budget
+        assert dying.hits >= 1
+        assert m["retries"] >= dying.hits
+        assert m["retry_budget_tokens"] < r.budget.burst
+
+    def test_all_workers_ejected_answers_fast_503(self, spawn_worker):
+        b1, p1 = spawn_worker()
+        b2, p2 = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        for w in r.workers():
+            w.breaker.eject()
+        t0 = time.monotonic()
+        status, payload = _post_sample(r)
+        elapsed = time.monotonic() - t0
+        assert status == 503
+        assert b"no routable worker" in payload
+        assert elapsed < 0.5  # O(1) shed, no dead-socket wait
+        assert r.metrics()["no_worker"] == 1
+
+    def test_shed_storm_exhausts_budget_to_honest_503(self, spawn_worker):
+        b1, p1 = spawn_worker()
+        b2, p2 = spawn_worker()
+        b1.mode = b2.mode = "shed"
+        r = _router(retry_ratio=0.0, retry_burst=1.0, max_attempts=4)
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        status, payload = _post_sample(r)
+        assert status == 503
+        assert b"retry budget exhausted" in payload
+        m = r.metrics()
+        assert m["budget_exhausted"] == 1
+        assert m["retries"] == 1  # the single token, then the honest 503
+
+    def test_no_worker_retry_refunds_its_budget_token(self, spawn_worker):
+        # 2 workers, one ejected: a connect-failure on the survivor finds
+        # nowhere to retry — the token spent for that retry must come
+        # back, or a brownout drains the shared bucket on retries that
+        # never happen
+        dying, p1 = spawn_worker()
+        _, p2 = spawn_worker()
+        dying.mode = "die"
+        r = _router(breaker_kwargs={"consecutive_failures": 100})
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        r.worker("w1").breaker.eject()
+        tokens_before = r.budget.tokens
+        status, payload = _post_sample(r)
+        assert status == 503
+        assert b"no routable worker" in payload
+        # deposit happens per request; the retry token was refunded
+        assert r.budget.tokens >= tokens_before
+        assert r.metrics()["no_worker"] == 1
+
+    def test_self_drained_worker_leaves_the_pool(self, spawn_worker):
+        # a worker drained directly (POST /admin/drain on the worker, not
+        # through the manager) reports draining in /metrics: the router
+        # must stop routing to it even though its breaker stays closed
+        behavior, p1 = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.health_pass()
+        assert r.worker("w0").routable
+        behavior.draining = True  # the worker marks itself
+        r.health_pass()  # scrape picks the flag up
+        assert not r.worker("w0").routable
+        status, payload = _post_sample(r)
+        assert status == 503
+        assert b"no routable worker" in payload
+
+    def test_ejection_then_half_open_readmission(self, spawn_worker):
+        flaky, p1 = spawn_worker()
+        steady, p2 = spawn_worker()
+        flaky.mode = "die"
+        r = _router(breaker_kwargs={"consecutive_failures": 1,
+                                    "reopen_after": 0.05})
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        for _ in range(6):
+            status, _ = _post_sample(r)
+            assert status == 200
+        ref = r.worker("w0")
+        assert not ref.routable  # ejected after its first death
+        assert r.metrics()["ejections"] == 1
+        # worker recovers; after the reopen window one probe re-admits it
+        flaky.mode = "ok"
+        time.sleep(0.06)
+        assert ref.breaker.state == "half_open"
+        r.health_pass()
+        assert ref.routable
+        hits_before = flaky.hits
+        for _ in range(10):
+            assert _post_sample(r)[0] == 200
+        assert flaky.hits > hits_before  # traffic actually returned
+
+    def test_warming_worker_admitted_only_when_ok(self, spawn_worker):
+        b, p = spawn_worker()
+        b.health = "warming"
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        assert not r.worker("w0").routable
+        status, _ = _post_sample(r)
+        assert status == 503  # nothing admittable yet
+        b.health = "ok"
+        r.health_pass()
+        assert r.worker("w0").routable
+        assert _post_sample(r)[0] == 200
+
+    def test_draining_worker_gets_no_new_requests(self, spawn_worker):
+        b1, p1 = spawn_worker()
+        b2, p2 = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        r.mark_draining("w0")
+        for _ in range(10):
+            assert _post_sample(r)[0] == 200
+        assert b1.hits == 0 and b2.hits == 10
+        # healthz shows the drain; un-draining restores routing
+        snap = [w for w in r.healthz()["workers"] if w["id"] == "w0"][0]
+        assert snap["draining"] and not snap["routable"]
+        r.mark_draining("w0", False)
+        for _ in range(20):
+            if _post_sample(r)[0] == 200 and b1.hits:
+                break
+        assert b1.hits > 0
+
+    def test_hung_scrape_ejects_an_idle_worker(self, spawn_worker):
+        # passive ejection must not require traffic: the health loop's
+        # scrape failing repeatedly trips the breaker too
+        b, p = spawn_worker()
+        r = _router(probe_timeout=0.5,
+                    breaker_kwargs={"consecutive_failures": 2})
+        ref = r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        assert ref.routable
+        # simulate the hang by pointing the scrape at a dead port
+        ref.base_url = "http://127.0.0.1:1"
+        r.health_pass()
+        r.health_pass()
+        assert not ref.routable
+
+    def test_http_front_end_serves_health_and_proxy(self, spawn_worker):
+        import urllib.request
+
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()  # pass 1 admits the worker...
+        r.health_pass()  # ...pass 2 scrapes its /metrics (generation)
+        srv = make_router_server(r, port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            with urllib.request.urlopen(f"{base}/healthz",
+                                        timeout=5.0) as resp:
+                health = json.loads(resp.read())
+            assert health["status"] == "ok" and health["routable"] == 1
+            assert health["generation"] == 1
+            req = urllib.request.Request(
+                f"{base}/v1/sample",
+                data=json.dumps({"data": [[0.5]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                body = json.loads(resp.read())
+            assert body["status"] == "ok"
+            with urllib.request.urlopen(f"{base}/metrics",
+                                        timeout=5.0) as resp:
+                metrics = json.loads(resp.read())
+            assert metrics["ok"] == 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ===========================================================================
+# the manager's draining restart (fake processes, real drain scrapes)
+# ===========================================================================
+
+class _FakeProc:
+    def __init__(self):
+        self._alive = True
+        self.pid = 4242
+        self.stopped = 0
+
+    def alive(self):
+        return self._alive
+
+    def stop(self, grace: float = 10.0):
+        self._alive = False
+        self.stopped += 1
+
+
+class TestDrainingRestart:
+    def _manager(self, tmp_path, router, port, **kw):
+        kw.setdefault("drain_timeout", 0.6)
+        kw.setdefault("warm_timeout", 5.0)
+        spawned = []
+
+        def spawn(slot, bundle_path):
+            proc = _FakeProc()
+            spawned.append((slot.id, bundle_path, proc))
+            return proc
+
+        mgr = FleetManager(router, str(tmp_path / "store"), num_workers=1,
+                           ports=[port], spawn=spawn, **kw)
+        mgr._spawned = spawned
+        return mgr
+
+    def test_drain_completes_when_pipeline_empties(self, tmp_path,
+                                                   spawn_worker):
+        behavior, port = spawn_worker()
+        behavior.in_flight = 2
+        r = _router()
+        mgr = self._manager(tmp_path, r, port, drain_timeout=5.0)
+        slot = mgr.slots[0]
+        mgr._launch(slot, "bundle-a")
+
+        def empty_soon():
+            time.sleep(0.3)
+            behavior.in_flight = 0
+            behavior.queue_depth = 0
+
+        threading.Thread(target=empty_soon, daemon=True).start()
+        assert mgr.drain_worker(slot) is True
+        assert behavior.draining  # the worker was told (POST /admin/drain)
+        assert r.worker("w0").draining  # and unrouted at the router
+
+    def test_drain_with_stuck_inflight_is_bounded_then_forced(
+            self, tmp_path, spawn_worker):
+        behavior, port = spawn_worker()
+        behavior.in_flight = 1  # never drains
+        r = _router()
+        mgr = self._manager(tmp_path, r, port, drain_timeout=0.5)
+        slot = mgr.slots[0]
+        mgr._launch(slot, "bundle-a")
+        t0 = time.monotonic()
+        assert mgr.drain_worker(slot) is False
+        assert time.monotonic() - t0 < 3.0  # bounded, not a hang
+
+    def test_rotate_forces_restart_and_waits_for_readmission(
+            self, tmp_path, spawn_worker):
+        behavior, port = spawn_worker()
+        behavior.in_flight = 1  # stuck: the rotation must force it
+        r = _router(probe_interval=0.05)
+        mgr = self._manager(tmp_path, r, port, drain_timeout=0.3)
+        slot = mgr.slots[0]
+        mgr._launch(slot, "bundle-a")
+        old_proc = slot.process
+        # a relaunched process starts fresh: not draining, empty pipeline
+        # (the fake worker server survives the "restart", so reset it at
+        # spawn time the way a real exec would)
+        orig_spawn = mgr._spawn
+
+        def spawn_fresh(slot_, bundle_path):
+            behavior.draining = False
+            behavior.in_flight = 0
+            return orig_spawn(slot_, bundle_path)
+
+        mgr._spawn = spawn_fresh
+        r.start_health_loop()
+        try:
+            ok = mgr.rotate_worker(slot, "bundle-b")
+        finally:
+            r.stop()
+        assert ok  # relaunched worker was re-admitted (healthz ok)
+        assert old_proc.stopped == 1  # the stuck process was torn down
+        assert slot.process is not old_proc
+        assert slot.bundle_path == "bundle-b"
+        assert slot.restarts == 1
+        assert not r.worker("w0").draining  # rotation cleared the mark
+
+    def test_probe_cmd_pins_feature_space_to_boot_incumbent(self, tmp_path):
+        # dis-feature probes must embed in ONE classifier space across
+        # rolls: the pin is the boot incumbent, not the rolling bundle
+        r = _router()
+        mgr = FleetManager(r, str(tmp_path / "store"), num_workers=1,
+                           ports=[1], spawn=lambda s, b: _FakeProc(),
+                           canary_data="canary.npz",
+                           canary_feature="dis_features")
+        mgr._feature_bundle = "bundle-gen0"  # pinned at boot
+        mgr.bundle_path = "bundle-gen5"  # the fleet rolled since
+        cmd = mgr._probe_cmd("bundle-gen6")
+        assert cmd[cmd.index("--feature-bundle") + 1] == "bundle-gen0"
+
+    def test_halted_roll_rolls_back_already_rotated_workers(
+            self, tmp_path, spawn_worker):
+        # 2-worker fleet rolling to a candidate: w0 rotates fine, w1
+        # fails to come back healthy — the candidate is quarantined AND
+        # w0 (already on the candidate) must roll back to the incumbent,
+        # never keep serving a quarantined generation
+        from gan_deeplearning4j_tpu.deploy.watcher import BundleCandidate
+
+        _, p0 = spawn_worker()
+        _, p1 = spawn_worker()
+        r = _router()
+        mgr = FleetManager(r, str(tmp_path / "store"), num_workers=2,
+                           ports=[p0, p1],
+                           spawn=lambda slot, bundle: _FakeProc(),
+                           drain_timeout=0.2, warm_timeout=0.2)
+        for slot in mgr.slots:
+            mgr._launch(slot, "bundle-old")
+        mgr.generation, mgr.bundle_path = 1, "bundle-old"
+        discards = []
+        mgr.watcher = type("W", (), {"discard": staticmethod(
+            lambda cand, reason, quarantine=False: discards.append(
+                (cand.generation, quarantine)))})()
+        rotations = []
+
+        def fake_rotate(slot, bundle_path):
+            rotations.append((slot.id, bundle_path))
+            if slot is mgr.slots[1] and bundle_path == "bundle-new":
+                return False  # w1 cannot boot the candidate
+            slot.bundle_path = bundle_path
+            return True
+
+        mgr.rotate_worker = fake_rotate
+        cand = BundleCandidate(path="bundle-new", generation=2,
+                               token="gen-2", manifest={})
+        assert mgr._admit_and_roll(cand) is True
+        assert discards == [(2, True)]  # quarantined fleet-wide, once
+        assert ("w0", "bundle-old") in rotations  # w0 rolled back
+        assert all(s.bundle_path == "bundle-old" for s in mgr.slots)
+        assert mgr.generation == 1  # fleet stays on the incumbent
+        assert mgr.status()["state"] == "halted"
+
+    def test_feature_repin_falls_back_to_candidate_when_incumbent_gone(
+            self, tmp_path, spawn_worker):
+        # dis_features mode with BOTH the pinned feature bundle and the
+        # incumbent GC'd: the re-pin must land on the candidate (the only
+        # embedding space still on disk) — a missing pin would fail every
+        # candidate probe and quarantine good generations forever
+        from gan_deeplearning4j_tpu.deploy.watcher import BundleCandidate
+
+        _, p0 = spawn_worker()
+        r = _router()
+        mgr = FleetManager(r, str(tmp_path / "store"), num_workers=1,
+                           ports=[p0],
+                           spawn=lambda slot, bundle: _FakeProc(),
+                           canary_data="canary.npz",
+                           canary_feature="dis_features",
+                           drain_timeout=0.2, warm_timeout=0.2)
+        mgr._launch(mgr.slots[0], "bundle-old")
+        mgr.generation = 1
+        mgr.bundle_path = str(tmp_path / "gc-ed-incumbent")  # gone
+        mgr._feature_bundle = str(tmp_path / "gc-ed-pin")  # gone too
+        cand_dir = tmp_path / "cand"
+        cand_dir.mkdir()
+        mgr._sidecar_probe = lambda path: {"fid": 1.0, "accuracy": None}
+        mgr.rotate_worker = lambda slot, bundle: True
+        cand = BundleCandidate(path=str(cand_dir), generation=2,
+                               token="gen-2", manifest={})
+        assert mgr._admit_and_roll(cand) is True
+        assert mgr._feature_bundle == str(cand_dir)
+        assert mgr.generation == 2  # rolled (ungated — no baseline exists)
+        events = [e["event"] for e in mgr.events]
+        assert "ungated_roll" in events
+
+    def test_halted_roll_keeps_incumbent_probe_baseline(
+            self, tmp_path, spawn_worker):
+        # the candidate passes the canary but the roll halts: the cached
+        # incumbent baseline must survive — rolling the cache forward
+        # before the roll completes would discard the real incumbent's
+        # probe (and, once its bundle is GC'd, admit the next candidate
+        # ungated despite a baseline having been measured)
+        from gan_deeplearning4j_tpu.deploy.watcher import BundleCandidate
+
+        _, p0 = spawn_worker()
+        r = _router()
+        mgr = FleetManager(r, str(tmp_path / "store"), num_workers=1,
+                           ports=[p0],
+                           spawn=lambda slot, bundle: _FakeProc(),
+                           canary_data="canary.npz",
+                           drain_timeout=0.2, warm_timeout=0.2)
+        mgr._launch(mgr.slots[0], "bundle-old")
+        mgr.generation, mgr.bundle_path = 1, "bundle-old"
+        incumbent_probe = {"fid": 1.0, "accuracy": 0.9}
+        mgr._incumbent_probes = {1: incumbent_probe}
+        mgr._sidecar_probe = lambda path: {"fid": 1.0, "accuracy": 0.9}
+        mgr.watcher = type("W", (), {"discard": staticmethod(
+            lambda cand, reason, quarantine=False: None)})()
+        mgr.rotate_worker = lambda slot, bundle: bundle != "bundle-new"
+        cand = BundleCandidate(path="bundle-new", generation=2,
+                               token="gen-2", manifest={})
+        assert mgr._admit_and_roll(cand) is True
+        assert mgr.status()["state"] == "halted"
+        assert mgr._incumbent_probes == {1: incumbent_probe}
+
+    def test_stop_mid_roll_neither_quarantines_nor_converges(
+            self, tmp_path, spawn_worker):
+        # shutdown killing a worker mid-rotation must read as
+        # infrastructure, not a canary verdict: the candidate generation
+        # is NOT quarantined and the fleet does not claim convergence
+        from gan_deeplearning4j_tpu.deploy.watcher import BundleCandidate
+
+        _, p0 = spawn_worker()
+        r = _router()
+        mgr = FleetManager(r, str(tmp_path / "store"), num_workers=1,
+                           ports=[p0],
+                           spawn=lambda slot, bundle: _FakeProc(),
+                           drain_timeout=0.2, warm_timeout=0.2)
+        mgr._launch(mgr.slots[0], "bundle-old")
+        mgr.generation, mgr.bundle_path = 1, "bundle-old"
+        discards = []
+        mgr.watcher = type("W", (), {"discard": staticmethod(
+            lambda cand, reason, quarantine=False: discards.append(
+                cand.generation))})()
+
+        def rotate_during_shutdown(slot, bundle):
+            mgr._stop.set()  # stop() landed while this rotation ran
+            return False
+
+        mgr.rotate_worker = rotate_during_shutdown
+        cand = BundleCandidate(path="bundle-new", generation=2,
+                               token="gen-2", manifest={})
+        assert mgr._admit_and_roll(cand) is True
+        assert discards == []  # no quarantine verdict on shutdown
+        assert mgr.generation == 1  # and no convergence claim
+        events = [e["event"] for e in mgr.events]
+        assert "roll_interrupted" in events
+
+    def test_supervise_relaunches_a_dead_process(self, tmp_path,
+                                                 spawn_worker):
+        behavior, port = spawn_worker()
+        r = _router()
+        mgr = self._manager(tmp_path, r, port)
+        slot = mgr.slots[0]
+        mgr._launch(slot, "bundle-a")
+        mgr.bundle_path = "bundle-a"
+        slot.process._alive = False  # SIGKILL shape
+        mgr._supervise_once()
+        assert slot.restarts == 1
+        assert slot.process.alive()
+        assert r.worker("w0").breaker.state == "init"  # must re-earn entry
+
+    def test_supervise_restarts_a_worker_stuck_in_init(self, tmp_path,
+                                                       spawn_worker):
+        # SIGSTOP (or a wedged warmup) BEFORE the first admission: the
+        # breaker sits in init forever — init probe failures never trip
+        # it — so hang detection must bound the launch→admission window
+        _, port = spawn_worker()
+        r = _router()
+        mgr = self._manager(tmp_path, r, port, warm_timeout=0.1)
+        slot = mgr.slots[0]
+        mgr._launch(slot, "bundle-a")
+        mgr.bundle_path = "bundle-a"
+        assert r.worker("w0").breaker.state == "init"
+        mgr._supervise_once()  # inside the allowance: left alone
+        assert slot.restarts == 0
+        time.sleep(0.15)
+        mgr._supervise_once()
+        assert slot.restarts == 1  # never-healthy worker forced out
+        # the relaunch re-arms the clock: no immediate second restart
+        mgr._supervise_once()
+        assert slot.restarts == 1
+
+
+# ===========================================================================
+# the subprocess drill (slow)
+# ===========================================================================
+
+@pytest.mark.slow
+class TestFleetDrill:
+    def test_drill_smoke(self, tmp_path):
+        out = tmp_path / "fleet_drill.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "fleet_drill.py"),
+             "--smoke", "--output", str(out),
+             "--workdir", str(tmp_path / "work")],
+            cwd=REPO, capture_output=True, text=True, timeout=1800,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, (
+            f"fleet drill breached invariants:\n{proc.stdout[-4000:]}\n"
+            f"{proc.stderr[-2000:]}")
+        payload = json.loads(out.read_text())
+        assert payload["ok"]
+        assert payload["invariants"]["exactly_one_answer_zero_lost"]
+        assert payload["invariants"]["poison_never_served"]

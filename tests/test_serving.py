@@ -214,6 +214,24 @@ class TestFastPath:
                    for c in eng.compile_counts.values())
         assert all(c == 0 for c in eng.serve_compile_counts.values())
 
+    def test_flight_lane_follows_the_routed_replica(self, checkpoints):
+        """dispatch stamps the flight's completion lane with the replica
+        it routed to — what the batcher's per-replica completer lanes key
+        on. Least-loaded routing alternates two back-to-back dispatches
+        across both replicas."""
+        gen_path, _ = checkpoints
+        eng = ServingEngine.from_checkpoints(
+            generator=gen_path, buckets=(1, 8), replicas=2,
+        )
+        eng.warmup()
+        rows = np.zeros((1, Z), np.float32)
+        f1 = eng.dispatch("sample", [rows])
+        f2 = eng.dispatch("sample", [rows])
+        assert {f1.lane, f2.lane} == {0, 1}
+        for f in (f1, f2):
+            assert f.lane == f.parts[0][3]  # the chunk's replica
+            eng.finalize(f)
+
     def test_bulk_lane_splits_oversized_batches_across_replicas(
             self, checkpoints):
         """A single caller batch ≥ top_bucket × replicas rides one
@@ -495,6 +513,98 @@ class TestPipelining:
         assert good.ok
         total = mb.metrics()
         assert sum(total["completed"].values()) + total["errors"] == 2
+
+
+class _LaneHandle:
+    """Flight handle with the ``lane`` attribute a multi-replica engine's
+    dispatch stamps (the batcher keys its completion lanes on it)."""
+
+    def __init__(self, kind, rows_list, lane):
+        self.kind = kind
+        self.rows_list = rows_list
+        self.lane = lane
+
+
+class _TwoReplicaEngine:
+    """Fake two-replica engine: kind 'slow' routes to replica 0 (long
+    finalize), everything else to replica 1 (fast finalize)."""
+
+    replica_count = 2
+    default_pipeline_depth = 4
+
+    def __init__(self, slow_s=0.4, fast_s=0.01):
+        self.finalize_s = {0: slow_s, 1: fast_s}
+
+    def dispatch(self, kind, rows_list):
+        return _LaneHandle(kind, [np.asarray(r) for r in rows_list],
+                           0 if kind == "slow" else 1)
+
+    def finalize(self, handle):
+        time.sleep(self.finalize_s[handle.lane])
+        rows = (handle.rows_list[0] if len(handle.rows_list) == 1
+                else np.concatenate(handle.rows_list))
+        return rows * 2.0
+
+
+class TestCompletionLanes:
+    """Per-replica completion lanes: one replica's slow finalize must not
+    head-of-line block another replica's already-finished flush (the PR 4
+    re-queued remainder)."""
+
+    def test_cross_replica_completion_overlap(self):
+        eng = _TwoReplicaEngine(slow_s=0.4, fast_s=0.01)
+        mb = MicroBatcher(engine=eng, max_latency=0.0, max_queue=64)
+        assert mb.metrics()["pipeline"]["lanes"] == 2
+        done = {}
+
+        def client(kind):
+            r = mb.submit(kind, np.ones((1, 3), np.float32), timeout=10.0)
+            done[kind] = (time.monotonic(), r)
+
+        t_slow = threading.Thread(target=client, args=("slow",))
+        t_fast = threading.Thread(target=client, args=("fast",))
+        t0 = time.monotonic()
+        t_slow.start()
+        time.sleep(0.05)  # the slow flush is dispatched (and finalizing)
+        t_fast.start()
+        t_fast.join(timeout=10.0)
+        t_slow.join(timeout=10.0)
+        mb.close()
+        assert done["fast"][1].ok and done["slow"][1].ok
+        # the fast lane completed while the slow finalize was still
+        # running: with the old single global completer the fast flush
+        # would have queued behind the 0.4s finalize ahead of it
+        assert done["fast"][0] < done["slow"][0]
+        assert done["fast"][0] - t0 < 0.3, (
+            "fast replica's completion was head-of-line blocked by the "
+            "slow replica's finalize")
+
+    def test_laneless_handles_ride_lane_zero(self):
+        # run_fn handles and single-replica fakes carry no lane: the
+        # batcher must fold them onto lane 0, reproducing the old
+        # single-completer behavior exactly
+        eng = _FakeAsyncEngine()
+        mb = MicroBatcher(engine=eng, max_latency=0.0)
+        assert mb.metrics()["pipeline"]["lanes"] == 1
+        r = mb.submit("k", np.ones((1, 2), np.float32), timeout=5.0)
+        mb.close()
+        assert r.ok
+
+    def test_lane_wider_than_batcher_folds_modulo(self):
+        # a swap to an engine with MORE replicas than the batcher has
+        # lanes must still finalize every flight (modulo folding)
+        class WideEngine(_TwoReplicaEngine):
+            def dispatch(self, kind, rows_list):
+                h = super().dispatch(kind, rows_list)
+                h.lane = 5  # beyond the 2 lanes the batcher built
+                return h
+
+        eng = WideEngine(slow_s=0.0, fast_s=0.0)
+        eng.finalize_s = {i: 0.0 for i in range(8)}
+        mb = MicroBatcher(engine=eng, max_latency=0.0)
+        r = mb.submit("k", np.ones((1, 2), np.float32), timeout=5.0)
+        mb.close()
+        assert r.ok
 
 
 class TestBatcher:
@@ -787,6 +897,30 @@ class TestServiceSmoke:
                                 {"data": [[0.1] * FEAT], "timeout": "5"})
         assert code == 200  # numeric strings coerce
         svc.close()
+
+
+class TestDrainState:
+    """POST /admin/drain — the fleet manager's draining-restart handshake
+    (docs/FLEET.md): the worker leaves the admittable /healthz set but
+    keeps answering until its pipeline empties."""
+
+    def test_drain_marks_clears_and_keeps_serving(self, engine):
+        svc = InferenceService(engine, warmup=False)
+        try:
+            assert svc.healthz()["status"] == "ok"
+            code, body = svc.handle("POST", "/admin/drain")
+            assert code == 200 and body["draining"] is True
+            assert svc.healthz()["status"] == "draining"
+            assert svc.metrics()["draining"] is True
+            # draining is advisory: in-flight and late requests still
+            # answer normally (the router stopped routing, not the worker)
+            assert svc.sample(np.zeros((2, Z), np.float32)).ok
+            code, body = svc.handle("POST", "/admin/drain?off=1")
+            assert code == 200 and body["draining"] is False
+            assert svc.healthz()["status"] == "ok"
+            assert svc.metrics()["draining"] is False
+        finally:
+            svc.close()
 
 
 class TestHttpServer:
