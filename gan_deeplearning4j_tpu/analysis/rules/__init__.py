@@ -35,6 +35,9 @@ from gan_deeplearning4j_tpu.analysis.rules.engine_swap import (
 from gan_deeplearning4j_tpu.analysis.rules.net_timeout import (
     UnboundedNetworkCall,
 )
+from gan_deeplearning4j_tpu.analysis.rules.state_spec import (
+    ShardedStateSpecMismatch,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -54,6 +57,7 @@ RULES = [
     TelemetryUnfencedTiming(),
     SwapSeamUnguardedAccess(),
     UnboundedNetworkCall(),
+    ShardedStateSpecMismatch(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,191 @@
+"""JG018 — sharded-state-spec-mismatch: updater state placed with a
+NamedSharding spec that disagrees with its paired params' spec.
+
+The update-sharding design ("Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training", PAPERS.md) rests on one invariant: a
+parameter and the optimizer/updater slots that step it live on the SAME
+partition of the mesh. Break it — params replicated (``PartitionSpec()``)
+while RmsProp caches shard over ``'data'``, or specs copy-pasted between
+the trainer and serving meshes — and one of two silent failures follows:
+jax inserts a reshard (all-gather or scatter of the full updater state)
+into EVERY training step, erasing exactly the HBM/step-time win update
+sharding exists for, or the first donated-buffer update hits a
+sharding-mismatch error minutes into a run on an exclusively-held chip.
+The mesh checkpoint plane (resilience/mesh.py) makes the same assumption
+on the restore side: shard manifests are resolved against the live spec,
+so a train-time mismatch becomes a restore-time surprise.
+
+The rule fires only on statically-certain evidence, in one scope:
+
+1. a value is *recognizably* params or updater state — its expression (or
+   the name it is assigned to) is an identifier containing ``param``, vs
+   one containing ``opt_state``/``updater``/``opt_states`` (the repo's
+   naming convention, enforced by the trainer API: ``TrainState.params`` /
+   ``TrainState.opt_state``);
+2. it is placed via ``jax.device_put(x, NamedSharding(mesh, spec))`` or
+   ``jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))``
+   with a LITERAL ``PartitionSpec`` (string/None/tuple-of-string entries);
+3. both roles are placed against the SAME mesh variable, every param
+   placement in the scope agrees on one spec, and an updater placement
+   uses a different one.
+
+Non-literal specs, unrecognized names, different mesh variables, and
+scopes where the param placements already disagree among themselves are
+silence, not a guess. Test modules are exempt (``skip_tests`` — parity
+tests build deliberately mismatched placements).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from gan_deeplearning4j_tpu.analysis import _common
+from gan_deeplearning4j_tpu.analysis.rules.mesh_axes import _scope_walk
+
+_PLACERS = {
+    "jax.device_put",
+    "jax.lax.with_sharding_constraint",
+    "jax.experimental.pjit.with_sharding_constraint",
+}
+_NAMED_SHARDING = {"jax.sharding.NamedSharding"}
+_PSPEC = {"jax.sharding.PartitionSpec"}
+
+_UPDATER_TOKENS = ("opt_state", "opt_states", "updater")
+_PARAM_TOKEN = "param"
+
+
+def _identifier(node: ast.AST) -> Optional[str]:
+    """The terminal identifier of a Name or dotted Attribute — the thing
+    role classification keys on (``self.opt_state`` -> ``opt_state``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _role(name: Optional[str]) -> Optional[str]:
+    if not name:
+        return None
+    lowered = name.lower()
+    if any(tok in lowered for tok in _UPDATER_TOKENS):
+        return "updater"
+    if _PARAM_TOKEN in lowered and "spec" not in lowered \
+            and "sharding" not in lowered:
+        return "param"
+    return None
+
+
+def _literal_spec(call: ast.Call) -> Optional[Tuple]:
+    """Normalize a ``PartitionSpec(...)`` call with fully literal entries
+    to a comparable tuple; None when any entry is non-literal."""
+    out: List = []
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and (
+                isinstance(arg.value, str) or arg.value is None):
+            out.append(arg.value)
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            entry = []
+            for elt in arg.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    return None
+                entry.append(elt.value)
+            out.append(tuple(entry))
+        else:
+            return None
+    if call.keywords:
+        return None
+    return tuple(out)
+
+
+def _spec_repr(spec: Tuple) -> str:
+    inner = ", ".join(repr(e) if not isinstance(e, tuple)
+                      else "(" + ", ".join(repr(x) for x in e) + ")"
+                      for e in spec)
+    return f"PartitionSpec({inner})"
+
+
+class ShardedStateSpecMismatch:
+    code = "JG018"
+    name = "sharded-state-spec-mismatch"
+    summary = ("updater/optimizer state sharded with a spec that disagrees "
+               "with its paired params")
+    skip_tests = True
+
+    def _placements(self, mod, scope):
+        """(role, mesh_name, spec, node) for every statically-certain
+        placement in the scope's own statements. ``_scope_walk`` yields
+        every node, so placer calls are processed where they are MET (once
+        each); a first pass maps a call assigned whole to a single Name —
+        ``opt_state = jax.device_put(optimizer.init(p), ...)`` — to that
+        name, the role fallback when the placed expression is anonymous."""
+        assigned_name: Dict[int, str] = {}
+        placer_calls: List[ast.Call] = []
+        for node in _scope_walk(scope):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                assigned_name[id(node.value)] = node.targets[0].id
+            if isinstance(node, ast.Call) \
+                    and mod.resolve(node.func) in _PLACERS:
+                placer_calls.append(node)
+        out = []
+        for call in placer_calls:
+            if not call.args:
+                continue
+            value = call.args[0]
+            sharding = None
+            if len(call.args) >= 2:
+                sharding = call.args[1]
+            for kw in call.keywords:
+                if kw.arg in ("device", "shardings", "sharding"):
+                    sharding = kw.value
+            if not (isinstance(sharding, ast.Call)
+                    and mod.resolve(sharding.func) in _NAMED_SHARDING
+                    and sharding.args
+                    and isinstance(sharding.args[0], ast.Name)):
+                continue
+            mesh_name = sharding.args[0].id
+            spec_call = sharding.args[1] if len(sharding.args) >= 2 \
+                else None
+            if not (isinstance(spec_call, ast.Call)
+                    and mod.resolve(spec_call.func) in _PSPEC):
+                continue
+            spec = _literal_spec(spec_call)
+            if spec is None:
+                continue
+            role = _role(_identifier(value))
+            if role is None:
+                role = _role(assigned_name.get(id(call)))
+            if role is None:
+                continue
+            out.append((role, mesh_name, spec, call))
+        return out
+
+    def check(self, mod):
+        for scope in _common.iter_scopes(mod.tree):
+            placements = self._placements(mod, scope)
+            by_mesh: Dict[str, List] = {}
+            for role, mesh_name, spec, node in placements:
+                by_mesh.setdefault(mesh_name, []).append((role, spec, node))
+            for mesh_name, group in by_mesh.items():
+                param_specs = {spec for role, spec, _ in group
+                               if role == "param"}
+                if len(param_specs) != 1:
+                    continue  # no param anchor, or params already disagree
+                param_spec = next(iter(param_specs))
+                for role, spec, node in group:
+                    if role == "updater" and spec != param_spec:
+                        yield mod.finding(
+                            self.code,
+                            f"updater state is placed on mesh "
+                            f"{mesh_name!r} with {_spec_repr(spec)} but its "
+                            f"paired params use {_spec_repr(param_spec)} — "
+                            f"every optimizer step will reshard the full "
+                            f"updater state (or fail at first use on "
+                            f"chip); shard updater slots with the same "
+                            f"spec as the params they step",
+                            node,
+                        ), node

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import time
 from typing import Dict, List, Optional
 
@@ -53,6 +54,12 @@ from gan_deeplearning4j_tpu.utils.metrics import MetricsLogger
 from gan_deeplearning4j_tpu.utils.profiling import PhaseTimer, device_trace
 
 logger = logging.getLogger(__name__)
+
+# one shard of a mesh-coordinated checkpoint (resilience/mesh.py):
+# <prefix>_state_shard-<K>-of-<M>.zip — presence of any such file marks a
+# generation directory as mesh-sharded and routes load_models through the
+# elastic merge path
+_MESH_SHARD_RE = re.compile(r"_state_shard-(\d{4})-of-(\d{4})\.zip$")
 
 
 def shape_struct(tree):
@@ -816,6 +823,57 @@ class GanExperiment:
             out.append(path)
         return out
 
+    # -- mesh-sharded checkpoints (resilience/mesh.py) --------------------
+    def _flat_state(self) -> Dict:
+        """Every trained state as ONE flat ``<model>/{params|updater|step}/
+        ...`` dict — the key namespace the mesh checkpoint plane shards
+        over. Sorted-key determinism is what lets N workers agree on a
+        partition without communicating."""
+        from gan_deeplearning4j_tpu.utils.serializer import _flatten
+
+        flat: Dict = {}
+        _flatten("dis/params", self.dis_state.params, flat)
+        _flatten("dis/updater", self.dis_state.opt_state, flat)
+        flat["dis/step"] = self.dis_state.step
+        _flatten("gan/params", self.gan_state.params, flat)
+        _flatten("gan/updater", self.gan_state.opt_state, flat)
+        flat["gan/step"] = self.gan_state.step
+        _flatten("gen/params", self.gen_params, flat)
+        if self.cv is not None:
+            _flatten("CV/params", self.cv_state.params, flat)
+            _flatten("CV/updater", self.cv_state.opt_state, flat)
+            flat["CV/step"] = self.cv_state.step
+        return flat
+
+    def save_model_shard(self, directory: str, shard_index: int,
+                         shard_count: int) -> List[str]:
+        """Write THIS worker's shard of the trained state (its slice of
+        the deterministic key partition) into ``directory`` — the per-
+        worker writer of the mesh store's coordinated publish. Returns the
+        relative filenames written (the shard manifest's file list).
+        ``shard_count=1`` degenerates to a full single-file checkpoint in
+        the same format."""
+        from gan_deeplearning4j_tpu.utils.serializer import (
+            shard_keys,
+            write_state_shard,
+        )
+
+        flat = self._flat_state()
+        mine = shard_keys(flat, shard_index, shard_count)
+        name = (f"{self.config.file_prefix}_state_shard-"
+                f"{shard_index:04d}-of-{shard_count:04d}.zip")
+        write_state_shard(
+            os.path.join(directory, name),
+            {k: flat[k] for k in mine},
+            meta={
+                "shard_index": int(shard_index),
+                "shard_count": int(shard_count),
+                "step": int(self.gan_state.step),
+                "total_keys": len(flat),
+            },
+        )
+        return [name]
+
     def publish_for_serving(self, directory: Optional[str] = None,
                             store=None) -> Dict:
         """Publish the trained INFERENCE artifacts — the paper's end product:
@@ -914,11 +972,20 @@ class GanExperiment:
         """Resume: restore every state ``save_models`` wrote (params + updater
         + step — the capability the reference's saveUpdater=true format
         implies but never exercises, SURVEY §5 checkpoint/resume). Returns
-        the restored iteration count."""
+        the restored iteration count.
+
+        **Elastic mesh restore:** a directory holding
+        ``*_state_shard-K-of-M.zip`` files is a mesh generation written by
+        M coordinated workers; the shards are merged and reassembled onto
+        THIS experiment regardless of M — a generation written by any mesh
+        shape restores bit-exactly onto any other (including M=1 and the
+        serve path), because the shard partition is a pure re-grouping of
+        the same flat key space."""
         from gan_deeplearning4j_tpu.utils.serializer import ModelSerializer, read_model
 
         cfg = self.config
-        prefix = os.path.join(directory or cfg.output_dir, cfg.file_prefix)
+        directory = directory or cfg.output_dir
+        prefix = os.path.join(directory, cfg.file_prefix)
 
         def _placed(state):
             if self.mesh is not None:
@@ -935,6 +1002,13 @@ class GanExperiment:
                 state = self._cast_state(state)
             return _placed(state)
 
+        shard_files = sorted(
+            n for n in os.listdir(directory)
+            if _MESH_SHARD_RE.search(n) and n.startswith(cfg.file_prefix)
+        )
+        if shard_files:
+            return self._load_models_sharded(directory, shard_files, _stored)
+
         self.dis_state = _stored(
             ModelSerializer.restore_train_state(f"{prefix}_dis_model.zip", self.dis_trainer)
         )
@@ -948,6 +1022,65 @@ class GanExperiment:
         _, gen_params, _, _ = read_model(f"{prefix}_gen_model.zip", load_updater=False)
         self.gen_params = _stored(gen_params)
         # the gan graph steps once per loop iteration — use it as the counter
+        self.batch_counter = int(self.gan_state.step)
+        return self.batch_counter
+
+    def _load_models_sharded(self, directory: str, shard_files: List[str],
+                             stored) -> int:
+        """Reassemble a mesh generation: merge every shard's flat arrays
+        (disjoint by construction, verified here), check the union covers
+        the writer's full key count, and rebuild each TrainState onto this
+        experiment's live trainers. ``stored`` is the caller's
+        cast-and-place closure so sharded and whole-file restores go
+        through one placement path."""
+        from gan_deeplearning4j_tpu.utils.serializer import (
+            _unflatten,
+            read_state_shard,
+        )
+
+        counts = set()
+        indices = []
+        flat: Dict = {}
+        total_keys = None
+        for name in shard_files:
+            arrays, meta = read_state_shard(os.path.join(directory, name))
+            counts.add(int(meta["shard_count"]))
+            indices.append(int(meta["shard_index"]))
+            total_keys = int(meta["total_keys"])
+            overlap = set(arrays) & set(flat)
+            if overlap:
+                raise ValueError(
+                    f"mesh shards overlap on keys {sorted(overlap)[:3]}... "
+                    f"— not one consistent generation")
+            flat.update(arrays)
+        if len(counts) != 1:
+            raise ValueError(
+                f"mesh shards disagree on shard_count ({sorted(counts)}) — "
+                f"files from different generations are mixed")
+        want = counts.pop()
+        if sorted(indices) != list(range(want)):
+            raise ValueError(
+                f"mesh generation incomplete: have shards {sorted(indices)} "
+                f"of {want} — refusing a partial restore")
+        if total_keys is not None and len(flat) != total_keys:
+            raise ValueError(
+                f"mesh generation torn: merged {len(flat)} keys, writer "
+                f"recorded {total_keys}")
+
+        def train_state(model: str, trainer) -> TrainState:
+            params = _unflatten(flat, f"{model}/params")
+            opt_state = _unflatten(flat, f"{model}/updater")
+            if not opt_state:
+                opt_state = trainer.optimizer.init(params)
+            step = jnp.asarray(int(np.asarray(flat[f"{model}/step"])),
+                               jnp.int32)
+            return TrainState(params, opt_state, step)
+
+        self.dis_state = stored(train_state("dis", self.dis_trainer))
+        self.gan_state = stored(train_state("gan", self.gan_trainer))
+        if self.cv is not None:
+            self.cv_state = stored(train_state("CV", self.cv_trainer))
+        self.gen_params = stored(_unflatten(flat, "gen/params"))
         self.batch_counter = int(self.gan_state.step)
         return self.batch_counter
 

@@ -15,9 +15,15 @@ makes a training run survivable:
   honors SIGTERM preemption by checkpointing then exiting cleanly, and
   guarantees *bit-exact* resume (interrupted-and-resumed == uninterrupted
   at equal total steps);
+- :mod:`.mesh` — the multi-worker coordinated checkpoint plane: N workers
+  stage per-shard manifests into one shared staging dir and worker 0
+  two-phase-commits the mesh generation (all-shards barrier → whole-mesh
+  digest commit marker → atomic rename), with elastic reshard-on-restore
+  (a generation written by M workers restores bit-exactly onto N);
 - :mod:`.faults` — a deterministic, seeded fault-injection plane (raise /
   preempt / kill at step N, slow or failed checkpoint writes, byte
-  corruption) that the drill and the tests drive;
+  corruption, mesh commit-window kills and straggler writers) that the
+  drill and the tests drive;
 - ``python -m gan_deeplearning4j_tpu.resilience`` — the supervised worker
   CLI ``scripts/resilience_drill.py`` launches, kills, and relaunches.
 
@@ -30,6 +36,12 @@ from gan_deeplearning4j_tpu.resilience.faults import (
     FaultSpec,
     InjectedFault,
     corrupt_generation,
+)
+from gan_deeplearning4j_tpu.resilience.mesh import (
+    MeshCoordinator,
+    MeshProtocolError,
+    MeshTimeout,
+    mesh_digest,
 )
 from gan_deeplearning4j_tpu.resilience.store import (
     CheckpointStore,
@@ -46,6 +58,10 @@ from gan_deeplearning4j_tpu.resilience.supervisor import (
 __all__ = [
     "CheckpointStore",
     "Generation",
+    "MeshCoordinator",
+    "MeshProtocolError",
+    "MeshTimeout",
+    "mesh_digest",
     "tree_digest",
     "FaultInjector",
     "FaultSchedule",

@@ -7,11 +7,25 @@ drill (and any orchestrator) relies on:
 - exit 0   — run completed (``total_steps`` reached, final generation
              published);
 - exit 75  — preempted (EX_TEMPFAIL: a checkpoint was published and the
-             worker exited cleanly; relaunch to continue);
+             worker exited cleanly; relaunch to continue) — in mesh mode
+             also any worker fault (``status: worker_fault``): in-process
+             retries are disabled under a gang, so the relauncher
+             restarts the whole mesh from the last coordinated
+             generation;
 - exit 70  — terminal (EX_SOFTWARE: retry budget exhausted — relaunching
              without intervention would fail the same way);
+- exit 76  — gang abort (EX_PROTOCOL: a mesh barrier timed out — a peer
+             worker is dead or wedged; relaunch the WHOLE mesh with a
+             fresh ``--mesh-token``, never just this worker);
 - killed by signal — a hard fault; the store still holds a consistent
              generation, so relaunching resumes from it.
+
+Mesh mode (``--mesh-size N --mesh-worker K``): N invocations of this CLI
+against ONE ``--store`` form a coordinated checkpoint gang — each worker
+stages its shard, worker 0 two-phase-commits the generation
+(docs/RESILIENCE.md, resilience/mesh.py). ``--mesh-token`` must be unique
+per gang launch (the relauncher's job) so stale rounds from a dead gang
+can never collide with live ones.
 
 The run summary (status, steps, restore/publish timings, fault events) is
 written as JSON to ``--summary`` and echoed to stdout.
@@ -51,6 +65,22 @@ def main(argv=None) -> int:
     p.add_argument("--backoff-max", type=float, default=30.0)
     p.add_argument("--keep-last", type=int, default=3)
     p.add_argument("--keep-every", type=int, default=0)
+    p.add_argument("--mesh-size", type=int, default=1,
+                   help="number of coordinated checkpoint workers sharing "
+                        "--store (1 = single-writer, the default)")
+    p.add_argument("--mesh-worker", type=int, default=0,
+                   help="this worker's id in [0, --mesh-size); worker 0 "
+                        "is the commit coordinator")
+    p.add_argument("--mesh-token", default="r0",
+                   help="gang-launch token — MUST be fresh per relaunch "
+                        "so a dead gang's staging can never be mistaken "
+                        "for a live round")
+    p.add_argument("--mesh-timeout", type=float, default=60.0,
+                   help="bound on every in-round mesh wait, seconds; "
+                        "expiry = gang abort (exit 76)")
+    p.add_argument("--mesh-boot-timeout", type=float, default=300.0,
+                   help="bound on the gang's first rendezvous (restore "
+                        "resolution), absorbing cold-start skew")
     p.add_argument("--fault-schedule", default=None,
                    help="FaultSchedule JSON file (docs/RESILIENCE.md)")
     p.add_argument("--summary", default=None,
@@ -69,11 +99,15 @@ def main(argv=None) -> int:
 
     from gan_deeplearning4j_tpu.harness import ExperimentConfig
     from gan_deeplearning4j_tpu.resilience import (
+        CheckpointStore,
         FaultInjector,
         FaultSchedule,
+        MeshCoordinator,
+        MeshTimeout,
         RetryBudgetExceeded,
         SupervisorConfig,
         TrainingSupervisor,
+        UnsupportedExperimentError,
     )
 
     from gan_deeplearning4j_tpu.telemetry import device as _device
@@ -93,9 +127,24 @@ def main(argv=None) -> int:
     cfg = ExperimentConfig.from_json(args.config)
     with np.load(args.data) as npz:
         features, labels = npz["features"], npz["labels"]
+    if not 0 <= args.mesh_worker < max(args.mesh_size, 1):
+        raise SystemExit(f"--mesh-worker {args.mesh_worker} outside mesh "
+                         f"of {args.mesh_size}")
     faults = None
     if args.fault_schedule:
-        faults = FaultInjector(FaultSchedule.from_json(args.fault_schedule))
+        faults = FaultInjector(FaultSchedule.from_json(args.fault_schedule),
+                               worker_id=args.mesh_worker)
+    mesh = None
+    store = None
+    if args.mesh_size > 1:
+        store = CheckpointStore(args.store, keep_last=args.keep_last,
+                                keep_every=args.keep_every,
+                                fault_injector=faults)
+        mesh = MeshCoordinator(
+            args.store, worker=args.mesh_worker, world_size=args.mesh_size,
+            token=args.mesh_token, timeout_s=args.mesh_timeout,
+            boot_timeout_s=args.mesh_boot_timeout, faults=faults,
+        )
     sup = TrainingSupervisor(
         cfg,
         SupervisorConfig(
@@ -109,9 +158,11 @@ def main(argv=None) -> int:
             serve_publish_every=args.serve_publish_every,
         ),
         features, labels,
+        store=store,
         store_root=args.store,
         faults=faults,
         serve_store_root=args.serve_store,
+        mesh=mesh,
     )
     sup.install_signal_handlers()
 
@@ -135,6 +186,24 @@ def main(argv=None) -> int:
         emit({"status": "terminal", "error": str(exc),
               "events": sup.events})
         return 70  # EX_SOFTWARE
+    except MeshTimeout as exc:
+        emit({"status": "mesh_abort", "error": str(exc),
+              "events": sup.events})
+        return 76  # EX_PROTOCOL: relaunch the whole gang, fresh token
+    except Exception as exc:
+        if mesh is None or isinstance(exc, UnsupportedExperimentError):
+            # single-writer faults are retried in-process by the
+            # supervisor, and a terminal config error retries into the
+            # same wall on any mesh — both deserve the loud traceback
+            raise
+        # mesh mode disables in-process retries (a retry would rejoin
+        # barriers its peers are not at), so ANY worker fault surfaces
+        # here; the remedy is the relauncher's — restart the whole gang
+        # with a fresh token — which is exactly what 75 asks for
+        emit({"status": "worker_fault",
+              "error": f"{type(exc).__name__}: {exc}",
+              "events": sup.events})
+        return 75  # EX_TEMPFAIL
     emit(summary)
     return 0 if summary["status"] == "completed" else 75  # EX_TEMPFAIL
 

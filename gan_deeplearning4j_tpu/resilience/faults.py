@@ -20,6 +20,25 @@ shape is a :class:`FaultSpec` kind:
                     in one member file of the published generation (the
                     bit-rot shape the store must quarantine).
 
+Multi-worker (mesh) shapes — the ways an N-writer coordinated publish can
+die, hooked by ``resilience/mesh.py``'s two-phase protocol:
+
+- ``straggler``     — sleep ``seconds`` before staging this worker's shard
+                      at the first mesh publish at or after step N (the
+                      slow-writer shape: the commit must wait, not tear);
+- ``kill_shard_staged`` — SIGKILL this worker right after its shard
+                      manifest lands but before the mesh commit (a writer
+                      dead inside the commit window);
+- ``kill_commit``   — SIGKILL the coordinator after the all-shards barrier
+                      but before the commit marker is written;
+- ``kill_committed`` — SIGKILL the coordinator after the commit marker but
+                      before the atomic rename / ledger write (the
+                      marker-without-publication window).
+
+Every spec may carry ``args: {"worker": k}`` to target one worker of a
+shared schedule; an injector constructed with ``worker_id`` skips specs
+aimed at other workers (specs without ``worker`` fire everywhere).
+
 Schedules are *deterministic*: either an explicit spec list or
 :meth:`FaultSchedule.seeded`, which derives (step, kind) pairs from a seed
 via ``random.Random`` — the same seed always yields the same faults, so a
@@ -45,7 +64,9 @@ FORMAT_VERSION = 1
 
 STEP_KINDS = ("raise", "preempt", "kill")
 WRITE_KINDS = ("slow_write", "fail_write")
-KINDS = STEP_KINDS + WRITE_KINDS + ("corrupt",)
+MESH_KINDS = ("straggler", "kill_shard_staged", "kill_commit",
+              "kill_committed")
+KINDS = STEP_KINDS + WRITE_KINDS + ("corrupt",) + MESH_KINDS
 
 
 class InjectedFault(RuntimeError):
@@ -157,15 +178,26 @@ class FaultInjector:
     assert slow-write behavior without wall-clock waits."""
 
     def __init__(self, schedule: Optional[FaultSchedule] = None,
-                 sleep=time.sleep) -> None:
+                 sleep=time.sleep, worker_id: Optional[int] = None) -> None:
         self.schedule = schedule or FaultSchedule()
         self._sleep = sleep
+        self.worker_id = worker_id
         self._fired: set = set()
         self.log: List[dict] = []
+
+    def _aimed_at_me(self, spec: FaultSpec) -> bool:
+        """A spec with ``args.worker`` targets ONE worker of a shared
+        schedule; without it (or without a worker identity) it fires
+        everywhere — single-process schedules keep working unchanged."""
+        target = spec.args.get("worker")
+        return (target is None or self.worker_id is None
+                or int(target) == self.worker_id)
 
     def _take(self, kinds, predicate):
         for i, spec in enumerate(self.schedule.specs):
             if i in self._fired or spec.kind not in kinds:
+                continue
+            if not self._aimed_at_me(spec):
                 continue
             if predicate(spec):
                 self._fired.add(i)
@@ -198,6 +230,34 @@ class FaultInjector:
             elif spec.kind == "fail_write":
                 raise OSError(
                     f"injected checkpoint write failure at step {step}")
+
+    # -- mesh (two-phase publish) hook points ---------------------------
+    def _kill_at(self, kind: str, step: int) -> None:
+        for spec in self._take((kind,), lambda s: step >= s.step):
+            self._record(spec, step)
+            os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, by design
+
+    def on_shard_write(self, step: int) -> None:
+        """Called by the mesh publish before this worker stages its shard
+        (``straggler`` sleeps here — the slow-writer shape)."""
+        for spec in self._take(("straggler",), lambda s: step >= s.step):
+            self._record(spec, step)
+            self._sleep(float(spec.args.get("seconds", 1.0)))
+
+    def on_shard_staged(self, step: int) -> None:
+        """Called after this worker's shard manifest (its phase-1 vote)
+        lands, before the mesh commit."""
+        self._kill_at("kill_shard_staged", step)
+
+    def on_mesh_commit(self, step: int) -> None:
+        """Coordinator only: after the all-shards barrier, before the
+        commit marker is written."""
+        self._kill_at("kill_commit", step)
+
+    def on_mesh_committed(self, step: int) -> None:
+        """Coordinator only: after the commit marker, before the atomic
+        rename publishes it and the ledger records it."""
+        self._kill_at("kill_committed", step)
 
     def on_published(self, store, generation) -> None:
         """Called by the supervisor after every successful publish."""

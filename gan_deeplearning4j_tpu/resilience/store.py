@@ -163,16 +163,23 @@ class CheckpointStore:
     slow/failed-write scenarios; production passes None."""
 
     def __init__(self, root: str, keep_last: int = 3, keep_every: int = 0,
-                 fault_injector=None) -> None:
+                 fault_injector=None, read_retries: int = 2,
+                 read_retry_backoff_s: float = 0.05,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
         if keep_last < 1:
             raise ValueError("keep_last must be >= 1 (the store must always "
                              "retain a newest generation)")
         if keep_every < 0:
             raise ValueError("keep_every must be >= 0 (0 = off)")
+        if read_retries < 0:
+            raise ValueError("read_retries must be >= 0 (0 = no retries)")
         self.root = os.path.abspath(root)
         self.keep_last = keep_last
         self.keep_every = keep_every
         self.faults = fault_injector
+        self.read_retries = read_retries
+        self.read_retry_backoff_s = read_retry_backoff_s
+        self._sleep = sleep
         self.generations_dir = os.path.join(self.root, "generations")
         self.quarantine_dir = os.path.join(self.root, "quarantine")
         os.makedirs(self.generations_dir, exist_ok=True)
@@ -197,6 +204,10 @@ class CheckpointStore:
             "resilience_generation",
             "newest published generation in the store this process opened "
             "(-1 = none)")
+        self._c_read_retries = registry.counter(
+            "resilience_read_retries_total",
+            "transient OSError store reads retried before verify/load "
+            "passed judgment (shared-filesystem flakes, not corruption)")
         # initialize from the directory scan: a fresh store must read -1,
         # not the gauge's 0.0 default — generation 0 is a REAL generation
         existing = self.published()
@@ -308,27 +319,62 @@ class CheckpointStore:
         # would inflate exactly the checkpoint-overhead number the drill
         # reports (the metric's help text pins write+digest+fsync+rename)
         t_published = time.perf_counter()
-        self._c_publishes.inc()
         self._h_publish.observe(t_published - t_publish)
-        self._g_generation.set(number)
         TRACER.complete("resilience.publish", t_publish, t_published,
                         {"gen": number, "step": int(step),
                          "kind": (extra or {}).get("kind", "training")})
+        self.note_published(number, step)
+        return Generation(number=number, path=final, manifest=manifest)
+
+    def note_published(self, number: int, step: int) -> None:
+        """Post-rename bookkeeping for a generation published by an
+        EXTERNAL committer (the mesh coordinator's two-phase publish lands
+        its own atomic rename): publish counter + gauge, the ledger entry,
+        and retention GC — one definition with :meth:`publish`'s own
+        epilogue so single-writer and mesh generations age identically."""
+        self._c_publishes.inc()
+        self._g_generation.set(number)
         self._update_ledger(number, status="published", step=int(step),
                             published_at=time.time())
         self.gc()
-        return Generation(number=number, path=final, manifest=manifest)
 
     # -- read side ------------------------------------------------------
+    def _retried_read(self, fn: Callable[[], "object"]):
+        """Run a read, retrying transient ``OSError`` with capped
+        exponential backoff before giving up. Shared-filesystem multi-host
+        runs (NFS-style mounts under the mesh plane) see sporadic EIO /
+        ESTALE on perfectly good bytes — without the retry, one flaky read
+        inside :meth:`verify` condemns a good generation to quarantine.
+        ``read_retries=0`` restores fail-fast. The final error propagates
+        to the caller, which still judges it exactly as before."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except OSError:
+                attempt += 1
+                if attempt > self.read_retries:
+                    raise
+                self._c_read_retries.inc()
+                self._sleep(min(1.0, self.read_retry_backoff_s
+                                * 2 ** (attempt - 1)))
+
+    def _read_manifest(self, path: str) -> dict:
+        def read():
+            with open(os.path.join(path, MANIFEST_NAME)) as fh:
+                return json.load(fh)
+        return self._retried_read(read)
+
     def verify(self, number: int) -> Optional[str]:
         """None when generation ``number`` is intact; otherwise the reason
         it is not (unparseable/missing manifest, missing member, size or
-        digest mismatch)."""
+        digest mismatch). Transient ``OSError`` reads are retried
+        (``read_retries`` with capped backoff) before a generation is
+        condemned — corruption verdicts stay immediate (a digest mismatch
+        is deterministic; re-reading cannot fix it)."""
         path = os.path.join(self.generations_dir, gen_dirname(number))
-        mpath = os.path.join(path, MANIFEST_NAME)
         try:
-            with open(mpath) as fh:
-                manifest = json.load(fh)
+            manifest = self._read_manifest(path)
         except (OSError, json.JSONDecodeError) as exc:
             return f"manifest unreadable: {exc}"
         if manifest.get("format_version", 0) > FORMAT_VERSION:
@@ -336,7 +382,8 @@ class CheckpointStore:
                     f"than supported {FORMAT_VERSION}")
         for name, meta in manifest.get("files", {}).items():
             try:
-                digest, size = _hash_file(os.path.join(path, name))
+                digest, size = self._retried_read(
+                    lambda name=name: _hash_file(os.path.join(path, name)))
             except OSError as exc:
                 return f"member {name!r} unreadable: {exc}"
             if size != meta["bytes"]:
@@ -354,8 +401,7 @@ class CheckpointStore:
             raise ValueError(
                 f"generation {number} fails verification: {reason}")
         path = os.path.join(self.generations_dir, gen_dirname(number))
-        with open(os.path.join(path, MANIFEST_NAME)) as fh:
-            manifest = json.load(fh)
+        manifest = self._read_manifest(path)
         return Generation(number=number, path=path, manifest=manifest)
 
     def latest_valid(self) -> Optional[Generation]:

@@ -108,7 +108,8 @@ class TrainingSupervisor:
                  sleep: Callable[[float], None] = time.sleep,
                  experiment_factory=None,
                  serve_store: Optional[CheckpointStore] = None,
-                 serve_store_root: Optional[str] = None) -> None:
+                 serve_store_root: Optional[str] = None,
+                 mesh=None) -> None:
         self.exp_config = exp_config
         self.sup = sup_config.validate()
         self.features = np.asarray(features)
@@ -137,6 +138,10 @@ class TrainingSupervisor:
         self.serve_store = serve_store
         self._serve_every = (self.sup.serve_publish_every
                              or self.sup.publish_every)
+        # mesh-coordinated mode (resilience/mesh.py): this worker is one
+        # of N sharded checkpoint writers; restores are resolved once for
+        # the gang and publishes go through the two-phase commit
+        self.mesh = mesh
         self.faults = faults
         self._sleep = sleep
         if experiment_factory is None:
@@ -202,17 +207,34 @@ class TrainingSupervisor:
     def _publish(self, exp) -> dict:
         t0 = time.perf_counter()
         digests = self.state_digests(exp)
-        generation = self.store.publish(
-            lambda d: exp.save_models(directory=d),
-            step=exp.batch_counter,
-            extra={"kind": "training", "state_digests": digests},
-        )
+        extra = {"kind": "training", "state_digests": digests}
+        if self.mesh is not None:
+            # coordinated mesh publish: THIS worker stages only its shard;
+            # worker 0's two-phase commit makes the generation visible for
+            # everyone (every worker blocks until publication or timeout)
+            generation = self.mesh.publish(
+                self.store,
+                lambda d: exp.save_model_shard(
+                    d, self.mesh.worker, self.mesh.world_size),
+                step=exp.batch_counter,
+                extra=extra,
+            )
+        else:
+            generation = self.store.publish(
+                lambda d: exp.save_models(directory=d),
+                step=exp.batch_counter,
+                extra=extra,
+            )
         seconds = time.perf_counter() - t0
         self.events.append({
             "event": "publish", "generation": generation.number,
             "step": exp.batch_counter, "seconds": seconds,
         })
-        if self.faults is not None:
+        if self.faults is not None and (self.mesh is None
+                                        or self.mesh.is_coordinator):
+            # post-publish faults (corrupt) mutate the published bytes —
+            # exactly one worker may fire them, or double byte-flips on
+            # one member would cancel back to valid bytes
             self.faults.on_published(self.store, generation)
         return {"generation": generation.number, "seconds": seconds,
                 "digests": digests}
@@ -240,6 +262,12 @@ class TrainingSupervisor:
         :class:`RetryBudgetExceeded` when retries are spent."""
         attempt = 0
         self._preempt = False  # a prior preempted run() must not poison this one
+        if self.mesh is not None:
+            # gang semantics (docs/RESILIENCE.md multi-host): an in-process
+            # retry would rejoin barriers its peers are not at — any fault
+            # propagates out, and the RELAUNCHER restarts the whole mesh
+            # with a fresh token
+            return self._run_attempt(0)
         while True:
             try:
                 return self._run_attempt(attempt)
@@ -285,7 +313,14 @@ class TrainingSupervisor:
                 "bit-exact resume is impossible; use a fused-path config "
                 "(distributed='none' or 'pmean')"
             )
-        generation = self.store.latest_valid()
+        if self.mesh is not None:
+            # ONE restore decision for the gang: worker 0 walks
+            # latest_valid() (performing any quarantines exactly once) and
+            # the peers load its published choice — N concurrent quarantine
+            # renames racing each other's digest walks would be chaos
+            generation = self.mesh.resolve_restore(self.store, attempt)
+        else:
+            generation = self.store.latest_valid()
         if generation is not None:
             with TRACER.span("resilience.restore", gen=generation.number,
                              attempt=attempt):
@@ -327,6 +362,8 @@ class TrainingSupervisor:
             if (self.serve_store is None
                     or exp.batch_counter == serve["last_step"]):
                 return
+            if self.mesh is not None and not self.mesh.is_coordinator:
+                return  # one serving bundle per mesh, from worker 0
             info = self._publish_serving(exp)
             serve["count"] += 1
             serve["generation"] = info.get("generation")
@@ -342,9 +379,15 @@ class TrainingSupervisor:
 
         while exp.batch_counter < self.sup.total_steps:
             if self._preempt:
-                publish()
-                serve_publish()  # a preempted trainer leaves its newest
-                # weights for the fleet, not just for its own resume
+                if self.mesh is None:
+                    publish()
+                    serve_publish()  # a preempted trainer leaves its newest
+                    # weights for the fleet, not just for its own resume
+                # mesh mode: a preemption publish would need every worker
+                # to reach this exact step — but SIGTERM lands mid-skew, so
+                # the gang exits WITHOUT a boundary publish and resumes
+                # from the last coordinated generation (≤ publish_every
+                # steps old, the same bound a hard kill already has)
                 segment_span("preempted")
                 return self._summary(
                     "preempted", exp, attempt, start_step, restore_s,

@@ -65,6 +65,111 @@ def _unflatten(flat: Dict[str, np.ndarray], prefix: str) -> Dict:
     return tree
 
 
+def _npz_encode(arrays: Dict[str, np.ndarray]) -> Tuple[bytes, Dict[str, str]]:
+    """Serialize a flat dict of host arrays to npz bytes. npz cannot
+    represent ml_dtypes extension types (bfloat16 round-trips as raw
+    void16, losing the dtype) — such arrays travel as uint16 bit patterns
+    with the real dtype recorded in the returned map."""
+    ext_dtypes: Dict[str, str] = {}
+    for key, value in list(arrays.items()):
+        if value.dtype == jnp.bfloat16:
+            arrays[key] = np.asarray(value).view(np.uint16)
+            ext_dtypes[key] = "bfloat16"
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue(), ext_dtypes
+
+
+def _npz_decode(npz_bytes: bytes, ext_dtypes: Dict[str, str]) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(npz_bytes)) as npz:
+        flat = {k: npz[k] for k in npz.files}
+    for key, name in ext_dtypes.items():
+        flat[key] = flat[key].view(jnp.dtype(name))
+    return flat
+
+
+def shard_keys(keys, shard_index: int, shard_count: int):
+    """The deterministic key partition of the mesh checkpoint plane:
+    shard ``shard_index`` of ``shard_count`` owns every ``shard_count``-th
+    key of the SORTED key list. Round-robin over the sorted order balances
+    leaf counts, is stable across processes (sorting is the only input),
+    and the union over all shards is exactly the full key set — the
+    property elastic restore merges on."""
+    if shard_count < 1:
+        raise ValueError("shard_count must be >= 1")
+    if not 0 <= shard_index < shard_count:
+        raise ValueError(f"shard_index {shard_index} outside "
+                         f"[0, {shard_count})")
+    return sorted(keys)[shard_index::shard_count]
+
+
+def write_state_shard(path: str, flat_arrays: Dict[str, np.ndarray],
+                      meta: Optional[dict] = None) -> None:
+    """One shard of a mesh checkpoint: a zip of ``arrays.npz`` (this
+    shard's flat ``<model>/params|updater/...`` keys only) + ``meta.json``
+    with per-member digests — the same self-verifying armor as
+    :func:`write_model`, minus topology (a mesh restore rebuilds onto the
+    live experiment's graphs). Lands temp+fsync+rename so the mesh
+    staging dir never holds a torn shard under a committed vote."""
+    arrays = dict(flat_arrays)
+    arrays = jax.device_get(arrays)  # one batched device->host transfer
+    npz_bytes, ext_dtypes = _npz_encode(arrays)
+    payload = {
+        "format_version": FORMAT_VERSION,
+        "array_dtypes": ext_dtypes,
+        "keys": sorted(arrays),
+        **(meta or {}),
+        "member_digests": {"arrays.npz": member_digest(npz_bytes)},
+    }
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            with zipfile.ZipFile(fh, "w", zipfile.ZIP_DEFLATED) as zf:
+                zf.writestr("meta.json", json.dumps(payload))
+                zf.writestr("arrays.npz", npz_bytes)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def read_state_shard(path: str) -> Tuple[Dict[str, np.ndarray], dict]:
+    """Load one mesh-checkpoint shard: (flat arrays, meta). Corruption or
+    truncation raises ``ValueError`` — same contract as
+    :func:`read_model`, so the store's quarantine machinery and the
+    elastic restore path judge shards and full checkpoints identically."""
+    try:
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read("meta.json"))
+            if meta["format_version"] > FORMAT_VERSION:
+                raise ValueError(
+                    f"shard format {meta['format_version']} is newer than "
+                    f"supported {FORMAT_VERSION}"
+                )
+            npz_bytes = zf.read("arrays.npz")
+            want = meta.get("member_digests", {}).get("arrays.npz")
+            if want is not None and member_digest(npz_bytes) != want:
+                raise ValueError(
+                    f"shard {path!r} member 'arrays.npz' fails digest "
+                    f"verification (expected {want}) — corrupted bytes"
+                )
+    except zipfile.BadZipFile as exc:
+        raise ValueError(
+            f"corrupted or truncated shard {path!r}: {exc}"
+        ) from exc
+    except KeyError as exc:
+        raise ValueError(
+            f"shard {path!r} is missing a required member: {exc}"
+        ) from exc
+    flat = _npz_decode(npz_bytes, meta.get("array_dtypes", {}))
+    return flat, meta
+
+
 def write_model(path: str, graph, state, save_updater: bool = True) -> None:
     """Serialize graph topology + params (+ updater state) to ``path``.
 
@@ -80,21 +185,10 @@ def write_model(path: str, graph, state, save_updater: bool = True) -> None:
     if opt_state is not None:
         _flatten("updater", opt_state, arrays)
     arrays = jax.device_get(arrays)  # one batched device->host transfer
-
-    # npz cannot represent ml_dtypes extension types (bfloat16 round-trips
-    # as raw void16, losing the dtype) — store such arrays as uint16 bit
-    # patterns and record the real dtype in meta (bf16 param storage,
-    # round-4 VERDICT item 3)
-    ext_dtypes: Dict[str, str] = {}
-    for key, value in list(arrays.items()):
-        if value.dtype == jnp.bfloat16:
-            arrays[key] = np.asarray(value).view(np.uint16)
-            ext_dtypes[key] = "bfloat16"
-
-    npz_buf = io.BytesIO()
-    np.savez(npz_buf, **arrays)
+    # bf16 param storage travels as tagged uint16 bit patterns (round-4
+    # VERDICT item 3) — shared with the mesh shard format
+    npz_bytes, ext_dtypes = _npz_encode(arrays)
     topology_bytes = json.dumps(graph.to_dict()).encode()
-    npz_bytes = npz_buf.getvalue()
     meta = {
         "format_version": FORMAT_VERSION,
         "step": int(step) if step is not None else 0,
@@ -166,8 +260,6 @@ def read_model(path: str, load_updater: bool = True) -> Tuple[object, Dict, Opti
                         f"verification (expected {want}) — corrupted bytes"
                     )
             topology = json.loads(topology_bytes)
-            with np.load(io.BytesIO(npz_bytes)) as npz:
-                flat = {k: npz[k] for k in npz.files}
     except zipfile.BadZipFile as exc:
         raise ValueError(
             f"corrupted or truncated checkpoint {path!r}: {exc}"
@@ -176,9 +268,14 @@ def read_model(path: str, load_updater: bool = True) -> Tuple[object, Dict, Opti
         raise ValueError(
             f"checkpoint {path!r} is missing a required member: {exc}"
         ) from exc
-    for key, name in meta.get("array_dtypes", {}).items():
-        # stored as uint16 bit patterns; view back to the real dtype
-        flat[key] = flat[key].view(jnp.dtype(name))
+    try:
+        flat = _npz_decode(npz_bytes, meta.get("array_dtypes", {}))
+    except zipfile.BadZipFile as exc:
+        # a pre-member_digests checkpoint can carry a torn npz the outer
+        # zip CRC missed; digest-carrying checkpoints never reach here
+        raise ValueError(
+            f"corrupted or truncated checkpoint {path!r}: {exc}"
+        ) from exc
 
     graph = ComputationGraph.from_dict(topology)
     params = _unflatten(flat, "params")

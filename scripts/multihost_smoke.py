@@ -20,9 +20,20 @@ prints a params checksum; the caller (tests/test_multihost.py or
 processes — the cross-host equivalent of the reference's broadcast-back
 invariant (SURVEY §3.3).
 
+Rendezvous discipline: with ``--barrier-root DIR`` (a directory every
+process can reach — the launcher's scratch dir locally, the shared store
+root on a real cluster) the processes meet at ``resilience/mesh.py`` file
+barriers instead of ad-hoc trust: a ``boot`` barrier BEFORE
+``jax.distributed`` init (so a never-launched peer surfaces as a bounded
+timeout and exit 3, not a gRPC dial that blocks forever) and a ``done``
+barrier after the last mode (so a peer that died mid-run fails THIS
+process loudly too, instead of leaving the parent to diff checksums
+against a ghost). Barrier timeout = exit 3, always nonzero.
+
 Run one process per host:
     python scripts/multihost_smoke.py --coordinator HOST:PORT \
-        --num-processes N --process-id I [--local-devices 2]
+        --num-processes N --process-id I [--local-devices 2] \
+        [--barrier-root DIR] [--barrier-timeout S]
 """
 
 from __future__ import annotations
@@ -41,6 +52,15 @@ def main() -> int:
                     help="virtual CPU devices per process (TPU: real chips)")
     ap.add_argument("--platform", default="cpu",
                     help="cpu (virtual mesh) or tpu (real pod slice)")
+    ap.add_argument("--barrier-root", default=None, metavar="DIR",
+                    help="shared dir for resilience.mesh file barriers — "
+                         "use a FRESH dir per launch (stale arrival "
+                         "markers from a previous run would satisfy the "
+                         "boot barrier instantly); omit to skip the "
+                         "barrier discipline (hosts without a shared "
+                         "filesystem)")
+    ap.add_argument("--barrier-timeout", type=float, default=240.0,
+                    help="bound on each rendezvous; expiry exits 3")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -51,6 +71,39 @@ def main() -> int:
                 flags + f" --xla_force_host_platform_device_count={args.local_devices}"
             ).strip()
 
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from gan_deeplearning4j_tpu.resilience.mesh import MeshCoordinator, MeshTimeout
+
+    barrier = None
+    if args.barrier_root:
+        # sweep=False: the smoke only borrows the BARRIER primitive — a
+        # shared barrier root may also be a live checkpoint gang's store
+        # root, and the coordinator's stale-gang sweep would read that
+        # gang's in-flight round as a corpse
+        barrier = MeshCoordinator(
+            args.barrier_root, worker=args.process_id,
+            world_size=args.num_processes, token="smoke",
+            timeout_s=args.barrier_timeout, sweep=False,
+        )
+
+    def rendezvous(name: str) -> None:
+        """Meet the other processes at a bounded file barrier — a peer
+        that never shows up becomes exit 3 here, not an unbounded gRPC
+        dial or a parent-side checksum diff against a ghost."""
+        if barrier is None:
+            return
+        try:
+            barrier.barrier(name)
+        except MeshTimeout as exc:
+            print(f"[multihost] BARRIER TIMEOUT at {name!r}: {exc}",
+                  flush=True)
+            raise SystemExit(3)
+
+    # boot rendezvous BEFORE jax.distributed: initialize_distributed's
+    # coordinator dial blocks unboundedly when a peer was never launched —
+    # the barrier turns that into a bounded, loud failure
+    rendezvous("boot")
+
     import jax
 
     if args.platform == "cpu":
@@ -60,7 +113,6 @@ def main() -> int:
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from gan_deeplearning4j_tpu.models import mlp_gan
     from gan_deeplearning4j_tpu.parallel import GraphTrainer, ParameterAveragingTrainer
     from gan_deeplearning4j_tpu.runtime.environment import initialize_distributed
@@ -191,6 +243,9 @@ def main() -> int:
         f"checksum={checksum((critic_state.params, gen_state.params))}",
         flush=True,
     )
+    # done rendezvous: a peer that died after its own modes must fail THIS
+    # process too — the smoke's contract is all-N-or-nobody
+    rendezvous("done")
     print(f"[multihost] process {args.process_id} OK", flush=True)
     return 0
 

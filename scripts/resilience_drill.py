@@ -21,6 +21,20 @@ its checkpoints, and checks that the resilience layer keeps every promise:
    its bytes flipped; a further run must quarantine it (ledger status
    ``quarantined``), restore from the prior generation, and complete.
 
+``--multihost N`` drills the MESH plane instead (resilience/mesh.py): N
+real worker processes share one store and two-phase-commit coordinated
+sharded generations. Phases: single-worker oracles (ground truth at
+``total`` and ``total + publish_every`` steps); a gang with a straggler
+shard writer and a seeded worker kill (the survivor must gang-abort with
+exit 76, the relaunched gang must finish digest-identical to the oracle);
+a coordinator killed INSIDE the commit window (marker written, rename
+never happens — the half-committed round must stay invisible to
+``latest_valid()`` and the relaunch must recover); and elastic resume
+(the 2-worker-written store extended on a 1-worker and a 2-worker mesh,
+both digest-identical to the uninterrupted oracle). ``--record TAG``
+writes ``BENCH_resilience_mh_<TAG>.json`` (recovery time, lost steps,
+commit overhead vs the single-writer publish).
+
 Results land as a BENCH-style JSON (``--output``, and ``--record TAG``
 additionally writes ``BENCH_resilience_<TAG>.json`` at the repo root).
 Exit status is nonzero on any invariant breach — non-bit-exact resume, a
@@ -78,6 +92,19 @@ def make_workload(workdir: str, seed: int) -> dict:
     return {"config": config_path, "data": data_path}
 
 
+def _load_summary(summary_path: str):
+    """The worker's --summary JSON, or None when it never landed or was
+    torn by a kill — one judgment shared by the single-worker and gang
+    paths."""
+    if not os.path.exists(summary_path):
+        return None
+    try:
+        with open(summary_path) as fh:
+            return json.load(fh)
+    except json.JSONDecodeError:
+        return None  # torn write from a killed worker — expected
+
+
 def run_worker(workload: dict, store: str, total_steps: int,
                publish_every: int, summary_path: str,
                schedule_path: str | None = None,
@@ -111,17 +138,299 @@ def run_worker(workload: dict, store: str, total_steps: int,
         log(f"worker hung past {timeout_s:.0f}s — killed")
         return None, None, time.perf_counter() - t0
     wall = time.perf_counter() - t0
-    summary = None
-    if os.path.exists(summary_path):
-        try:
-            with open(summary_path) as fh:
-                summary = json.load(fh)
-        except json.JSONDecodeError:
-            summary = None  # torn write from a killed worker — expected
+    summary = _load_summary(summary_path)
     if proc.returncode not in (0, 75) and proc.returncode >= 0:
         log(f"worker rc={proc.returncode} stderr tail: "
             f"{proc.stderr[-500:]}")
     return proc.returncode, summary, wall  # negative rc = death by signal
+
+
+def run_gang(workload: dict, store: str, total_steps: int,
+             publish_every: int, world_size: int, token: str,
+             summary_dir: str, schedules: dict | None = None,
+             mesh_timeout_s: float = 15.0, timeout_s: float = 600.0) -> list:
+    """One gang lifetime: ``world_size`` concurrent worker processes
+    against one store. ``schedules`` maps worker id -> fault schedule
+    path (workers absent from the map run clean). Returns a list of
+    (returncode, summary_or_None, wall_seconds) per worker; each worker's
+    summary lands in ``summary_dir/summary_<token>_w<k>.json``. Drained
+    concurrently — a sequential wait would deadlock against the mesh
+    barriers."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    os.makedirs(summary_dir, exist_ok=True)
+    env = {**os.environ, "GDT_COMPILATION_CACHE": "off"}
+    procs = []
+    for k in range(world_size):
+        cmd = WORKER + [
+            "--config", workload["config"], "--data", workload["data"],
+            "--store", store,
+            "--total-steps", str(total_steps),
+            "--publish-every", str(publish_every),
+            "--mesh-size", str(world_size),
+            "--mesh-worker", str(k),
+            "--mesh-token", token,
+            "--mesh-timeout", str(mesh_timeout_s),
+            "--summary",
+            os.path.join(summary_dir, f"summary_{token}_w{k}.json"),
+        ]
+        if schedules and k in schedules:
+            cmd += ["--fault-schedule", schedules[k]]
+        procs.append(subprocess.Popen(
+            cmd, cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env))
+    t0 = time.perf_counter()
+    results = []
+    with ThreadPoolExecutor(world_size) as pool:
+        futures = [pool.submit(p.communicate, timeout=timeout_s)
+                   for p in procs]
+        for k, future in enumerate(futures):
+            try:
+                _, err = future.result()
+            except subprocess.TimeoutExpired:
+                log(f"gang {token} worker {k} hung past {timeout_s:.0f}s "
+                    f"— killed")
+                for q in procs:
+                    q.kill()
+                err = ""
+            results.append(err)
+    # reap anything killed after a hang: its communicate() thread bailed
+    # on TimeoutExpired, so returncode would otherwise stay None and the
+    # rc classification below would crash instead of reporting the breach
+    for proc in procs:
+        if proc.returncode is None:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable — rc stays None, reported as a hang
+    wall = time.perf_counter() - t0
+    out = []
+    for k, (proc, err) in enumerate(zip(procs, results)):
+        summary = _load_summary(
+            os.path.join(summary_dir, f"summary_{token}_w{k}.json"))
+        if proc.returncode is None:
+            log(f"gang {token} worker {k} unreaped after kill — "
+                f"treating as hung (rc=None)")
+        elif proc.returncode not in (0, 75, 76) and proc.returncode >= 0:
+            log(f"gang {token} worker {k} rc={proc.returncode} stderr "
+                f"tail: {err[-500:]}")
+        out.append((proc.returncode, summary, wall))
+    return out
+
+
+def _gang_digests(gang: list) -> list:
+    """state_digests of every completed worker in a gang result."""
+    return [s.get("state_digests") for rc, s, _ in gang
+            if rc == 0 and s is not None]
+
+
+def run_multihost_drill(args, workdir: str, total: int,
+                        publish_every: int) -> dict:
+    """The mesh-plane drill (see module docstring). Returns the BENCH
+    payload; ``invariants`` within gate the exit code."""
+    from gan_deeplearning4j_tpu.resilience import (
+        CheckpointStore,
+        FaultSchedule,
+        FaultSpec,
+    )
+    from gan_deeplearning4j_tpu.resilience.mesh import MESH_STAGE_PREFIX
+
+    n = args.multihost
+    workload = make_workload(workdir, args.seed)
+    results: dict = {}
+    invariants: dict = {}
+    total_ext = total + publish_every
+
+    def stage_dirs(store_root: str) -> list:
+        return sorted(d for d in os.listdir(store_root)
+                      if d.startswith(MESH_STAGE_PREFIX))
+
+    def all_published_verify(store_root: str) -> bool:
+        store = CheckpointStore(store_root)
+        return all(store.verify(g) is None for g in store.published())
+
+    # -- phase 1: single-worker oracles (ground truth) -------------------
+    log(f"oracle: single worker, {total} and {total_ext} uninterrupted "
+        f"steps")
+    rc, oracle, oracle_wall = run_worker(
+        workload, os.path.join(workdir, "store_oracle"), total,
+        publish_every, os.path.join(workdir, "summary_oracle.json"))
+    rc2, oracle_ext, _ = run_worker(
+        workload, os.path.join(workdir, "store_oracle_ext"), total_ext,
+        publish_every, os.path.join(workdir, "summary_oracle_ext.json"))
+    if (rc != 0 or oracle is None or oracle.get("status") != "completed"
+            or rc2 != 0 or oracle_ext is None
+            or oracle_ext.get("status") != "completed"):
+        log(f"oracle runs failed (rc={rc}/{rc2}) — cannot drill")
+        return {"ok": False, "invariants": {"oracle_completed": False}}
+    results["oracle"] = {
+        "wall_s": oracle_wall,
+        "publish_count": oracle["publish_count"],
+        "checkpoint_write_s_mean": (
+            oracle["publish_s"] / oracle["publish_count"]
+            if oracle["publish_count"] else 0.0),
+    }
+
+    # -- phase 2: worker kill + straggler under coordinated publish ------
+    mesh_store = os.path.join(workdir, "store_mesh")
+    if args.kill_step is not None:
+        kill_step = args.kill_step
+    else:
+        seeded = FaultSchedule.seeded(args.seed, total, kinds=("kill",))
+        kill_step = max(seeded.specs[0].step, publish_every + 1)
+    victim = n - 1  # a non-coordinator writer; the coordinator dies in p3
+    schedule = FaultSchedule([
+        FaultSpec(kind="straggler", step=publish_every,
+                  args={"seconds": 0.3}),
+        FaultSpec(kind="kill", step=kill_step),
+    ])
+    schedule_path = os.path.join(workdir, "faults_mesh.json")
+    schedule.to_json(schedule_path)
+    log(f"mesh kill/recover: {n} workers, straggler at publish "
+        f"{publish_every}, SIGKILL worker {victim} at step {kill_step}")
+    gang1 = run_gang(workload, mesh_store, total, publish_every, n, "g1",
+                     workdir, schedules={victim: schedule_path},
+                     mesh_timeout_s=args.mesh_timeout)
+    rcs = [rc for rc, _, _ in gang1]
+    invariants["mh_kill_observed"] = rcs[victim] is not None and \
+        rcs[victim] < 0
+    invariants["mh_gang_aborted"] = any(rc == 76 for rc in rcs)
+    # all-or-nothing: every generation the dead gang left behind is
+    # complete and digest-clean, and nothing beyond the kill step surfaced
+    store = CheckpointStore(mesh_store)
+    latest = store.latest_valid()
+    invariants["mh_no_partial_generation"] = (
+        all_published_verify(mesh_store)
+        and latest is not None and latest.step <= kill_step)
+    log(f"gang g1 rcs={rcs}; latest valid step="
+        f"{latest.step if latest else None}")
+
+    t_recover = time.perf_counter()
+    gang2 = run_gang(workload, mesh_store, total, publish_every, n, "g2",
+                     workdir, mesh_timeout_s=args.mesh_timeout)
+    recovery_wall = time.perf_counter() - t_recover
+    digests2 = _gang_digests(gang2)
+    invariants["mh_recovered"] = len(digests2) == n
+    invariants["mh_workers_agree"] = (
+        len(digests2) == n and all(d == digests2[0] for d in digests2))
+    invariants["mh_bit_exact_resume"] = (
+        bool(digests2) and digests2[0] == oracle.get("state_digests"))
+    coord_summary = gang2[0][1] or {}
+    restores = [e for e in coord_summary.get("events", [])
+                if e.get("event") == "restore"]
+    restored_step = restores[0]["step"] if restores else 0
+    mesh_publish_mean = (
+        coord_summary.get("publish_s", 0.0)
+        / coord_summary.get("publish_count", 1)
+        if coord_summary.get("publish_count") else None)
+    results["kill_recover"] = {
+        "kill_step": kill_step,
+        "victim": victim,
+        "gang1_rcs": rcs,
+        "recovery_wall_s": recovery_wall,
+        "restore_s": coord_summary.get("restore_s"),
+        "time_to_first_step_s": coord_summary.get("time_to_first_step_s"),
+        "restored_step": restored_step,
+        "lost_steps": kill_step - restored_step,
+        "mesh_publish_s_mean": mesh_publish_mean,
+        "commit_overhead_vs_single": (
+            mesh_publish_mean / results["oracle"]["checkpoint_write_s_mean"]
+            if mesh_publish_mean
+            and results["oracle"]["checkpoint_write_s_mean"] else None),
+    }
+
+    # -- phase 3: coordinator killed inside the commit window ------------
+    commit_store = os.path.join(workdir, "store_mesh_commit")
+    window_step = 2 * publish_every  # publish 1 must land, publish 2 dies
+    schedule = FaultSchedule([
+        FaultSpec(kind="kill_committed", step=window_step),
+    ])
+    commit_schedule_path = os.path.join(workdir, "faults_commit.json")
+    schedule.to_json(commit_schedule_path)
+    log(f"commit-window kill: coordinator dies after the commit marker "
+        f"of the publish at step {window_step}, before the rename")
+    gang3 = run_gang(workload, commit_store, total, publish_every, n,
+                     "g3", workdir, schedules={0: commit_schedule_path},
+                     mesh_timeout_s=args.mesh_timeout)
+    rcs3 = [rc for rc, _, _ in gang3]
+    store = CheckpointStore(commit_store)
+    latest = store.latest_valid()
+    leftovers = stage_dirs(commit_store)
+    # the half-committed round: marker written, never renamed — it must be
+    # invisible to latest_valid() (fall back to the previous generation)
+    # and absent from the ledger
+    attempted = (store.published()[-1] + 1) if store.published() else 0
+    invariants["mh_commit_window_all_or_nothing"] = (
+        rcs3[0] is not None and rcs3[0] < 0
+        and any(rc == 76 for rc in rcs3[1:])
+        and bool(leftovers)
+        and any(os.path.exists(os.path.join(commit_store, d,
+                                            "MANIFEST.json"))
+                for d in leftovers)
+        and latest is not None
+        and latest.step == publish_every
+        and store.entry(attempted) == {})
+    log(f"gang g3 rcs={rcs3}; leftovers={leftovers}; latest="
+        f"{latest.step if latest else None}")
+    gang4 = run_gang(workload, commit_store, total, publish_every, n,
+                     "g4", workdir, mesh_timeout_s=args.mesh_timeout)
+    digests4 = _gang_digests(gang4)
+    invariants["mh_commit_window_recovered"] = (
+        len(digests4) == n
+        and digests4[0] == oracle.get("state_digests")
+        and not stage_dirs(commit_store))  # the corpse round was swept
+    results["commit_window"] = {
+        "window_step": window_step,
+        "gang_rcs": rcs3,
+        "stage_leftovers": leftovers,
+        "fallback_step": latest.step if latest else None,
+    }
+
+    # -- phase 4: elastic resume — M=2-written store onto N∈{1,2} --------
+    elastic: dict = {}
+    for shape, label in ((1, "mesh_to_single"), (n, "mesh_to_mesh")):
+        src = os.path.join(workdir, f"store_elastic_{shape}")
+        shutil.copytree(mesh_store, src)
+        log(f"elastic resume: {n}-written store extended to {total_ext} "
+            f"steps on {shape} worker(s)")
+        if shape == 1:
+            rc, summary, wall = run_worker(
+                workload, src, total_ext, publish_every,
+                os.path.join(workdir, "summary_elastic1.json"))
+            digests = [summary.get("state_digests")] if rc == 0 and summary \
+                else []
+        else:
+            gang = run_gang(workload, src, total_ext, publish_every,
+                            shape, f"g5-{shape}", workdir,
+                            mesh_timeout_s=args.mesh_timeout)
+            digests = _gang_digests(gang)
+        ok = bool(digests) and all(
+            d == oracle_ext.get("state_digests") for d in digests)
+        invariants[f"mh_elastic_{label}"] = ok
+        elastic[label] = {"workers": shape, "bit_exact": ok}
+    results["elastic"] = elastic
+
+    ok = all(invariants.values())
+    return {
+        "bench": "resilience_drill_multihost",
+        "config": {
+            "total_steps": total,
+            "publish_every": publish_every,
+            "world_size": n,
+            "kill_step": kill_step,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "mesh_timeout_s": args.mesh_timeout,
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "results": results,
+        "invariants": invariants,
+        "telemetry": {
+            "oracle": oracle.get("telemetry"),
+            "recovered_coordinator": coord_summary.get("telemetry"),
+        },
+        "ok": ok,
+    }
 
 
 def main(argv=None) -> int:
@@ -134,6 +443,13 @@ def main(argv=None) -> int:
                    help="override the seeded kill step")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--relaunch-budget", type=int, default=5)
+    p.add_argument("--multihost", type=int, default=0, metavar="N",
+                   help="drill the MESH plane with N coordinated worker "
+                        "processes sharing one store (0 = single-host "
+                        "drill, the default)")
+    p.add_argument("--mesh-timeout", type=float, default=15.0,
+                   help="mesh in-round wait bound handed to the workers "
+                        "(multihost mode); expiry = gang abort")
     p.add_argument("--workdir", default=None,
                    help="keep work files here instead of a temp dir")
     p.add_argument("--output", default=None, metavar="PATH",
@@ -148,6 +464,33 @@ def main(argv=None) -> int:
     workdir = args.workdir or tempfile.mkdtemp(prefix="resilience_drill_")
     cleanup = args.workdir is None
     os.makedirs(workdir, exist_ok=True)
+
+    if args.multihost:
+        if args.multihost < 2:
+            p.error("--multihost needs N >= 2 (one coordinator plus at "
+                    "least one peer writer)")
+        payload = run_multihost_drill(args, workdir, total, publish_every)
+        invariants = payload.get("invariants", {})
+        ok = bool(payload.get("ok"))
+        text = json.dumps(payload, indent=2)
+        print(text)
+        if args.output:
+            os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                        exist_ok=True)
+            with open(args.output, "w") as fh:
+                fh.write(text + "\n")
+        if args.record:
+            with open(os.path.join(
+                    _REPO, f"BENCH_resilience_mh_{args.record}.json"),
+                    "w") as fh:
+                fh.write(text + "\n")
+        if cleanup and ok:
+            shutil.rmtree(workdir, ignore_errors=True)
+        elif not ok:
+            log(f"INVARIANT BREACH — work files kept at {workdir}")
+        for name, good in sorted(invariants.items()):
+            log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+        return 0 if ok else 1
 
     from gan_deeplearning4j_tpu.resilience import (
         CheckpointStore,

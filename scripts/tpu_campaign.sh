@@ -60,6 +60,19 @@ if ! JAX_PLATFORMS=cpu timeout 600 python scripts/resilience_drill.py --smoke \
   echo "$(date +%H:%M:%S) resilience drill smoke failed — campaign aborted (see resilience_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Multihost resilience smoke (CPU, 2-worker coordinated-checkpoint gang):
+# the mesh plane's all-or-nothing commit and elastic reshard-on-restore
+# are the multi-worker campaign's crash-safety story — refuse to start if
+# a worker kill or a coordinator killed inside the commit window can
+# surface a partial generation, or if a 2-written store stops restoring
+# bit-exactly on 1- and 2-worker meshes (enforced by the drill's own exit
+# code). Pinned to CPU so it never touches the chip.
+if ! JAX_PLATFORMS=cpu timeout 900 python scripts/resilience_drill.py --smoke \
+    --multihost 2 \
+    --output artifacts/resilience_mh_smoke.json > resilience_mh_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) multihost resilience drill smoke failed — campaign aborted (see resilience_mh_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 # Reload smoke (CPU, subprocess train→serve loop): the campaign's artifacts
 # feed a fleet that updates weights while serving — refuse to start if the
 # zero-downtime swap, the canary quarantine, or the supervisor's serve-

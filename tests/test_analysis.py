@@ -1814,6 +1814,145 @@ class TestUnboundedNetworkCall:
 
 
 # ===========================================================================
+# JG018 — updater state sharded unlike its paired params
+# ===========================================================================
+
+class TestShardedStateSpecMismatch:
+    def test_true_positive_replicated_params_sharded_updater(self):
+        # the update-sharding hazard: params replicated, RmsProp caches
+        # sharded — every step reshards the full updater state
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def place(mesh, params, opt_state):\n"
+            "    params = jax.device_put(params,\n"
+            "        NamedSharding(mesh, PartitionSpec()))\n"
+            "    opt_state = jax.device_put(opt_state,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))\n"
+            "    return params, opt_state\n"
+        )
+        assert codes(r) == ["JG018"]
+        assert "reshard" in r.active[0].message
+
+    def test_true_positive_role_from_assigned_name(self):
+        # the placed expression is anonymous (optimizer.init(p)); the role
+        # comes from the name the placement is assigned to
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def build(mesh, optimizer, p):\n"
+            "    params = jax.device_put(p,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))\n"
+            "    opt_state = jax.device_put(optimizer.init(p),\n"
+            "        NamedSharding(mesh, PartitionSpec()))\n"
+            "    return params, opt_state\n"
+        )
+        assert codes(r) == ["JG018"]
+
+    def test_true_positive_with_sharding_constraint_attr_roles(self):
+        # constraint form inside a step fn; roles read off the attribute
+        # names (TrainState.params / TrainState.opt_state)
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def step(mesh, state):\n"
+            "    p = jax.lax.with_sharding_constraint(state.params,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))\n"
+            "    o = jax.lax.with_sharding_constraint(state.opt_state,\n"
+            "        NamedSharding(mesh, PartitionSpec(('data', 'model'))))\n"
+            "    return p, o\n"
+        )
+        assert codes(r) == ["JG018"]
+
+    def test_true_negative_matching_specs(self):
+        # the corrected idiom: updater slots shard exactly like the params
+        # they step
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def place(mesh, params, opt_state):\n"
+            "    params = jax.device_put(params,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))\n"
+            "    opt_state = jax.device_put(opt_state,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))\n"
+            "    return params, opt_state\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_different_meshes_silent(self):
+        # train vs serve meshes legitimately use different layouts
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def place(train_mesh, serve_mesh, params, opt_state):\n"
+            "    params = jax.device_put(params,\n"
+            "        NamedSharding(train_mesh, PartitionSpec()))\n"
+            "    opt_state = jax.device_put(opt_state,\n"
+            "        NamedSharding(serve_mesh, PartitionSpec('data')))\n"
+            "    return params, opt_state\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_non_literal_spec_silent(self):
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def place(mesh, params, opt_state, axis):\n"
+            "    params = jax.device_put(params,\n"
+            "        NamedSharding(mesh, PartitionSpec()))\n"
+            "    opt_state = jax.device_put(opt_state,\n"
+            "        NamedSharding(mesh, PartitionSpec(axis)))\n"
+            "    return params, opt_state\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_params_already_disagree(self):
+        # no single param anchor to judge the updater against — silence,
+        # not a guess
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def place(mesh, p1, p2, opt_state):\n"
+            "    param_a = jax.device_put(p1,\n"
+            "        NamedSharding(mesh, PartitionSpec()))\n"
+            "    param_b = jax.device_put(p2,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))\n"
+            "    opt_state = jax.device_put(opt_state,\n"
+            "        NamedSharding(mesh, PartitionSpec('model')))\n"
+            "    return param_a, param_b, opt_state\n"
+        )
+        assert codes(r) == []
+
+    def test_skips_test_modules(self):
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def test_mismatch(mesh, params, opt_state):\n"
+            "    params = jax.device_put(params,\n"
+            "        NamedSharding(mesh, PartitionSpec()))\n"
+            "    opt_state = jax.device_put(opt_state,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))\n"
+            "    return params, opt_state\n",
+            path="tests/test_specs.py",
+        )
+        assert codes(r) == []
+
+    def test_suppression_applies(self):
+        r = run(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def place(mesh, params, opt_state):\n"
+            "    params = jax.device_put(params,\n"
+            "        NamedSharding(mesh, PartitionSpec()))\n"
+            "    opt_state = jax.device_put(opt_state,\n"
+            "        NamedSharding(mesh, PartitionSpec('data')))  # jaxlint: disable=JG018\n"
+            "    return params, opt_state\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG018"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

@@ -19,11 +19,14 @@ from gan_deeplearning4j_tpu.resilience import (
     FaultSchedule,
     FaultSpec,
     InjectedFault,
+    MeshCoordinator,
+    MeshTimeout,
     RetryBudgetExceeded,
     SupervisorConfig,
     TrainingSupervisor,
     UnsupportedExperimentError,
     corrupt_generation,
+    mesh_digest,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -294,8 +297,18 @@ class FakeExperiment:
         with open(os.path.join(directory, "state.txt"), "w") as fh:
             fh.write(str(self.batch_counter))
 
+    def save_model_shard(self, directory, shard_index, shard_count):
+        # the fake's "state" is one counter, replicated per shard — enough
+        # to exercise the coordinated-publish/elastic-restore plumbing
+        name = f"state_shard-{shard_index:04d}-of-{shard_count:04d}.txt"
+        with open(os.path.join(directory, name), "w") as fh:
+            fh.write(str(self.batch_counter))
+        return [name]
+
     def load_models(self, directory=None):
-        with open(os.path.join(directory, "state.txt")) as fh:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith("state") and n.endswith(".txt"))
+        with open(os.path.join(directory, names[0])) as fh:
             self.batch_counter = int(fh.read())
         return self.batch_counter
 
@@ -599,3 +612,473 @@ class TestDrillSmoke:
         assert results["kill_recover"]["completed"]
         assert results["oracle"]["publish_count"] >= 3
         assert results["oracle"]["checkpoint_overhead_frac"] < 1.0
+
+
+# ===========================================================================
+# the mesh plane — coordinated sharded checkpointing (resilience/mesh.py)
+# ===========================================================================
+
+def shard_writer(payload):
+    """A mesh shard writer: writes a dict of name -> bytes, returns the
+    names (the per-shard manifest's file list)."""
+    def writer(directory):
+        for name, data in payload.items():
+            with open(os.path.join(directory, name), "wb") as fh:
+                fh.write(data)
+        return list(payload)
+    return writer
+
+
+def run_mesh(root, world_size, publish_args, token="t1", timeout_s=10.0,
+             faults_by_worker=None, store=None):
+    """Run one coordinated publish across ``world_size`` worker threads.
+    Returns per-worker results: a Generation or the raised exception."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    store = store or CheckpointStore(root)
+    coords = [
+        MeshCoordinator(
+            root, worker=k, world_size=world_size, token=token,
+            timeout_s=timeout_s,
+            faults=(faults_by_worker or {}).get(k),
+        )
+        for k in range(world_size)
+    ]
+
+    def one(k):
+        writer, step = publish_args(k)
+        try:
+            return coords[k].publish(store, writer, step=step)
+        except Exception as exc:  # collected, asserted by the caller
+            return exc
+
+    with ThreadPoolExecutor(world_size) as pool:
+        return list(pool.map(one, range(world_size))), store
+
+
+class HookRaise:
+    """A fault injector that raises at ONE named mesh hook — the
+    in-process stand-in for a worker dying at that exact protocol point
+    (the drill does it with real SIGKILLs)."""
+
+    def __init__(self, hook):
+        self.hook = hook
+
+    def _fire(self, name):
+        if name == self.hook:
+            raise RuntimeError(f"injected death at {name}")
+
+    def on_shard_write(self, step):
+        self._fire("on_shard_write")
+
+    def on_shard_staged(self, step):
+        self._fire("on_shard_staged")
+
+    def on_mesh_commit(self, step):
+        self._fire("on_mesh_commit")
+
+    def on_mesh_committed(self, step):
+        self._fire("on_mesh_committed")
+
+
+class TestMeshBarrier:
+    def test_barrier_meets_across_workers(self, tmp_path):
+        from concurrent.futures import ThreadPoolExecutor
+
+        root = str(tmp_path)
+        coords = [MeshCoordinator(root, worker=k, world_size=3,
+                                  timeout_s=10.0) for k in range(3)]
+        with ThreadPoolExecutor(3) as pool:
+            list(pool.map(lambda c: c.barrier("up"), coords))  # no raise
+
+    def test_barrier_timeout_is_loud(self, tmp_path):
+        coord = MeshCoordinator(str(tmp_path), worker=0, world_size=2,
+                                timeout_s=0.2)
+        with pytest.raises(MeshTimeout, match="gang abort"):
+            coord.barrier("up")
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            MeshCoordinator(str(tmp_path), worker=2, world_size=2)
+        with pytest.raises(ValueError):
+            MeshCoordinator(str(tmp_path), worker=0, world_size=0)
+        with pytest.raises(ValueError):
+            MeshCoordinator(str(tmp_path), worker=0, world_size=1,
+                            token="no/slashes")
+
+
+class TestMeshPublish:
+    def test_two_phase_publish_round_trip(self, tmp_path):
+        root = os.path.join(str(tmp_path), "store")
+        results, store = run_mesh(
+            root, 2,
+            lambda k: (shard_writer({f"shard{k}.bin": bytes([k]) * 64}), 5))
+        assert all(not isinstance(r, Exception) for r in results), results
+        assert [g.number for g in results] == [0, 0]
+        gen = store.latest_valid()
+        assert gen is not None and gen.step == 5
+        # the combined manifest covers every staged file and verifies
+        assert {"shard0.bin", "shard1.bin"} <= set(gen.manifest["files"])
+        assert store.verify(0) is None
+        mesh = gen.manifest["mesh"]
+        assert mesh["world_size"] == 2
+        assert mesh["shards"] == ["SHARD-00000.json", "SHARD-00001.json"]
+        # the whole-mesh digest is recomputable from the manifest alone
+        assert mesh["mesh_digest"] == mesh_digest(gen.manifest["files"])
+        assert store.entry(0)["status"] == "published"
+
+    def test_second_round_gets_next_number(self, tmp_path):
+        root = os.path.join(str(tmp_path), "store")
+        _, store = run_mesh(
+            root, 2,
+            lambda k: (shard_writer({f"a{k}.bin": b"x" * 8}), 3))
+        results, _ = run_mesh(
+            root, 2,
+            lambda k: (shard_writer({f"b{k}.bin": b"y" * 8}), 6),
+            store=store)
+        assert [g.number for g in results] == [1, 1]
+        assert store.published() == [0, 1]
+
+    def test_empty_shard_rejected(self, tmp_path):
+        root = os.path.join(str(tmp_path), "store")
+        results, _ = run_mesh(
+            root, 1, lambda k: (shard_writer({}), 1), timeout_s=1.0)
+        assert isinstance(results[0], Exception)
+        assert "empty shard" in str(results[0])
+
+    def test_colliding_shard_files_rejected(self, tmp_path):
+        from gan_deeplearning4j_tpu.resilience import MeshProtocolError
+
+        root = os.path.join(str(tmp_path), "store")
+        results, store = run_mesh(
+            root, 2,
+            lambda k: (shard_writer({"same.bin": bytes([k]) * 8}), 1),
+            timeout_s=2.0)
+        # the coordinator refuses the commit; nothing publishes
+        assert any(isinstance(r, MeshProtocolError) for r in results)
+        assert store.latest_valid() is None
+
+
+class TestMeshCommitWindow:
+    """The satellite invariant: a writer killed anywhere inside the commit
+    window leaves a round ``latest_valid()`` can NEVER surface — it falls
+    back to the previous generation, and the corpse is swept on the next
+    gang's open."""
+
+    def _prior_generation(self, root):
+        store = CheckpointStore(root)
+        store.publish(write_files({"prior.bin": b"prior"}), step=1)
+        return store
+
+    def _stage_dirs(self, root):
+        from gan_deeplearning4j_tpu.resilience.mesh import MESH_STAGE_PREFIX
+
+        return sorted(d for d in os.listdir(root)
+                      if d.startswith(MESH_STAGE_PREFIX))
+
+    @pytest.mark.parametrize("hook,marker_expected", [
+        # killed between shard staging and the mesh commit: no marker
+        ("on_mesh_commit", False),
+        # killed between the commit marker and the rename/ledger write:
+        # the marker exists — but only inside the staging dir
+        ("on_mesh_committed", True),
+    ])
+    def test_coordinator_killed_in_commit_window(self, tmp_path, hook,
+                                                 marker_expected):
+        root = os.path.join(str(tmp_path), "store")
+        store = self._prior_generation(root)
+        results, _ = run_mesh(
+            root, 2,
+            lambda k: (shard_writer({f"s{k}.bin": b"z" * 16}), 4),
+            timeout_s=1.5, store=store,
+            faults_by_worker={0: HookRaise(hook)})
+        assert isinstance(results[0], RuntimeError)  # the injected death
+        assert isinstance(results[1], MeshTimeout)   # peer gang-aborts
+        # the round is a corpse in staging: latest_valid falls back to the
+        # prior generation and the ledger never saw the attempt
+        leftovers = self._stage_dirs(root)
+        assert len(leftovers) == 1
+        marker = os.path.join(root, leftovers[0], "MANIFEST.json")
+        assert os.path.exists(marker) == marker_expected
+        latest = store.latest_valid()
+        assert latest is not None and latest.number == 0 and latest.step == 1
+        assert store.entry(1) == {}
+        # the next gang's coordinator (fresh token) sweeps the corpse
+        MeshCoordinator(root, worker=0, world_size=2, token="t2")
+        assert self._stage_dirs(root) == []
+        # and can publish the SAME number cleanly afterwards
+        results, _ = run_mesh(root, 2,
+                              lambda k: (shard_writer({f"n{k}.bin": b"n"}),
+                                         4),
+                              token="t2", store=store)
+        assert [g.number for g in results] == [1, 1]
+        assert store.latest_valid().number == 1
+
+    def test_worker_killed_before_vote_aborts_commit(self, tmp_path):
+        root = os.path.join(str(tmp_path), "store")
+        store = self._prior_generation(root)
+        results, _ = run_mesh(
+            root, 2,
+            lambda k: (shard_writer({f"s{k}.bin": b"z" * 16}), 4),
+            timeout_s=1.5, store=store,
+            faults_by_worker={1: HookRaise("on_shard_write")})
+        assert isinstance(results[1], RuntimeError)
+        assert isinstance(results[0], MeshTimeout)  # coordinator aborts
+        latest = store.latest_valid()
+        assert latest is not None and latest.number == 0
+
+    def test_straggler_shard_writer_still_commits(self, tmp_path):
+        import time
+
+        class SleepAt:
+            def on_shard_write(self, step):
+                time.sleep(0.3)
+
+            def on_shard_staged(self, step):
+                pass
+
+        root = os.path.join(str(tmp_path), "store")
+        results, store = run_mesh(
+            root, 2,
+            lambda k: (shard_writer({f"s{k}.bin": b"z" * 16}), 4),
+            timeout_s=5.0, faults_by_worker={1: SleepAt()})
+        assert all(not isinstance(r, Exception) for r in results), results
+        assert store.latest_valid().number == 0
+
+
+class TestMeshSupervisor:
+    """The supervisor's mesh mode, on fakes: coordinated publishes at the
+    shared cadence, one restore decision for the gang, gang abort on a
+    dead peer."""
+
+    def _run_gang(self, tmp_path, total, token, world=2, dead=()):
+        from concurrent.futures import ThreadPoolExecutor
+
+        root = os.path.join(str(tmp_path), "store")
+
+        def one(k):
+            if k in dead:
+                return None  # never launched — peers must gang-abort
+            coord = MeshCoordinator(root, worker=k, world_size=world,
+                                    token=token, timeout_s=1.5,
+                                    boot_timeout_s=1.5)
+            sup = fake_supervisor(
+                tmp_path, SupervisorConfig(total_steps=total,
+                                           publish_every=4))
+            sup.store = CheckpointStore(root)
+            sup.mesh = coord
+            try:
+                return sup.run()
+            except MeshTimeout as exc:
+                return exc
+
+        with ThreadPoolExecutor(world) as pool:
+            return list(pool.map(one, range(world)))
+
+    def test_coordinated_cadence_and_elastic_restore(self, tmp_path):
+        out = self._run_gang(tmp_path, total=10, token="tA")
+        assert all(o["status"] == "completed" for o in out)
+        store = CheckpointStore(os.path.join(str(tmp_path), "store"))
+        gen = store.latest_valid()
+        assert gen.step == 10 and gen.manifest["mesh"]["world_size"] == 2
+        # both shard files of the final round are in the manifest
+        shard_files = [n for n in gen.manifest["files"]
+                       if "state_shard" in n]
+        assert len(shard_files) == 2
+        # a second gang (fresh token) restores from the mesh generation
+        # and both workers agree on the restored counter
+        out2 = self._run_gang(tmp_path, total=16, token="tB")
+        assert all(o["status"] == "completed" for o in out2)
+        for o in out2:
+            restores = [e for e in o["events"] if e["event"] == "restore"]
+            assert [r["step"] for r in restores] == [10]
+        assert store.latest_valid().step == 16
+
+    def test_dead_peer_gang_aborts_both_phases(self, tmp_path):
+        out = self._run_gang(tmp_path, total=10, token="tC", dead=(1,))
+        assert out[1] is None
+        assert isinstance(out[0], MeshTimeout)
+        # nothing half-published
+        store = CheckpointStore(os.path.join(str(tmp_path), "store"))
+        assert store.latest_valid() is None
+
+
+class TestMeshReshardParity:
+    def test_generation_written_by_m_workers_restores_bit_exact(
+            self, tmp_path):
+        """The elastic-resume contract, in-process: generations of the
+        SAME trained state written by M∈{1,2,4} shard writers all restore
+        digest-identical onto a fresh single experiment (N=1, the serve
+        path) — resharding is a pure regrouping of bytes. The drill
+        proves the N=2 process-level half."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+        from gan_deeplearning4j_tpu.resilience.store import tree_digest
+
+        feats, labels = tabular_data()
+        cfg = tabular_cfg(tmp_path)
+        exp = GanExperiment(cfg)
+        for step in range(2):
+            f, l = feats[:8], labels[:8]
+            exp.train_iteration(f, l)
+            exp.batch_counter += 1
+        want = {
+            "dis": tree_digest(exp.dis_state),
+            "gan": tree_digest(exp.gan_state),
+            "gen": tree_digest(exp.gen_params),
+        }
+
+        root = os.path.join(str(tmp_path), "store")
+        store = CheckpointStore(root)
+        by_m = {}
+        for m in (1, 2, 4):
+            coords = [MeshCoordinator(root, worker=k, world_size=m,
+                                      token=f"m{m}", timeout_s=20.0)
+                      for k in range(m)]
+
+            def publish(k, m=m, coords=coords):
+                return coords[k].publish(
+                    store,
+                    lambda d: exp.save_model_shard(d, k, m),
+                    step=2)
+
+            with ThreadPoolExecutor(m) as pool:
+                gens = list(pool.map(publish, range(m)))
+            by_m[m] = gens[0]
+
+        for m, gen in by_m.items():
+            fresh = GanExperiment(cfg)
+            assert fresh.load_models(directory=gen.path) == 2
+            got = {
+                "dis": tree_digest(fresh.dis_state),
+                "gan": tree_digest(fresh.gan_state),
+                "gen": tree_digest(fresh.gen_params),
+            }
+            assert got == want, f"M={m} restore diverged"
+
+    def test_partial_mesh_generation_refused(self, tmp_path):
+        """A generation directory with a missing shard (however it got
+        that way) must refuse to restore, never half-load."""
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+
+        cfg = tabular_cfg(tmp_path)
+        exp = GanExperiment(cfg)
+        d = os.path.join(str(tmp_path), "gen")
+        os.makedirs(d)
+        exp.save_model_shard(d, 0, 2)  # shard 1 of 2 never lands
+        fresh = GanExperiment(cfg)
+        with pytest.raises(ValueError, match="incomplete"):
+            fresh.load_models(directory=d)
+
+
+# ===========================================================================
+# bounded-retry reads — transient store I/O (shared-filesystem flakes)
+# ===========================================================================
+
+class TestReadRetries:
+    def _flaky_hash(self, monkeypatch, failures, member="m.bin"):
+        from gan_deeplearning4j_tpu.resilience import store as store_mod
+
+        real = store_mod._hash_file
+        budget = {"n": failures}
+
+        def flaky(path, fsync=False):
+            if budget["n"] > 0 and path.endswith(member):
+                budget["n"] -= 1
+                raise OSError("injected transient EIO")
+            return real(path, fsync)
+
+        monkeypatch.setattr(store_mod, "_hash_file", flaky)
+        return budget
+
+    def test_transient_read_retried_not_quarantined(self, tmp_path,
+                                                    monkeypatch):
+        sleeps = []
+        store = CheckpointStore(os.path.join(str(tmp_path), "s"),
+                                read_retries=2, sleep=sleeps.append)
+        store.publish(write_files({"m.bin": b"good bytes"}), step=1)
+        self._flaky_hash(monkeypatch, failures=2)
+        gen = store.latest_valid()
+        assert gen is not None and gen.number == 0
+        assert store.quarantined() == []  # the flake did NOT condemn it
+        # capped exponential backoff between attempts
+        assert sleeps == [0.05, 0.1]
+
+    def test_retries_exhausted_falls_back(self, tmp_path, monkeypatch):
+        store = CheckpointStore(os.path.join(str(tmp_path), "s"),
+                                read_retries=1, sleep=lambda s: None)
+        store.publish(write_files({"old.bin": b"old"}), step=1)
+        store.publish(write_files({"m.bin": b"new"}), step=2)
+        self._flaky_hash(monkeypatch, failures=50)  # a hard failure
+        gen = store.latest_valid()
+        # the persistently-unreadable newest generation quarantines and
+        # the walk falls back — exactly the old behavior, two reads later
+        assert gen is not None and gen.number == 0
+        assert store.quarantined() == [1]
+        assert "unreadable" in store.entry(1)["reason"]
+
+    def test_zero_retries_fails_fast(self, tmp_path, monkeypatch):
+        sleeps = []
+        store = CheckpointStore(os.path.join(str(tmp_path), "s"),
+                                read_retries=0, sleep=sleeps.append)
+        store.publish(write_files({"m.bin": b"x"}), step=1)
+        self._flaky_hash(monkeypatch, failures=1)
+        assert store.verify(0) is not None  # first error is the verdict
+        assert sleeps == []
+
+    def test_retry_counter_in_registry(self, tmp_path, monkeypatch):
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        def retried_total():
+            fam = get_registry().snapshot().get(
+                "resilience_read_retries_total", {})
+            return sum(s["value"] for s in fam.get("series", []))
+
+        store = CheckpointStore(os.path.join(str(tmp_path), "s"),
+                                read_retries=2, sleep=lambda s: None)
+        store.publish(write_files({"m.bin": b"x"}), step=1)
+        before = retried_total()
+        self._flaky_hash(monkeypatch, failures=2)
+        assert store.verify(0) is None
+        assert retried_total() - before == 2
+
+
+# ===========================================================================
+# the multihost drill — real processes, coordinated store, slow-gated
+# ===========================================================================
+
+class TestMultihostDrill:
+    @pytest.mark.slow
+    def test_multihost_drill_smoke(self, tmp_path):
+        """End to end through real worker gangs: straggler + worker
+        SIGKILL (survivor gang-aborts with 76), coordinator killed inside
+        the commit window (the half-committed round never surfaces),
+        bit-exact recovery, and elastic 2→{1,2} resume — the drill's own
+        invariants gate its exit code."""
+        out_json = os.path.join(str(tmp_path), "drill_mh.json")
+        proc = subprocess.run(
+            [sys.executable, "scripts/resilience_drill.py", "--smoke",
+             "--multihost", "2",
+             "--workdir", os.path.join(str(tmp_path), "work"),
+             "--output", out_json],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=1100,
+        )
+        assert proc.returncode == 0, (proc.stdout[-3000:],
+                                      proc.stderr[-2000:])
+        with open(out_json) as fh:
+            payload = json.load(fh)
+        assert payload["ok"] is True
+        inv = payload["invariants"]
+        assert inv["mh_kill_observed"] and inv["mh_gang_aborted"]
+        assert inv["mh_no_partial_generation"]
+        assert inv["mh_bit_exact_resume"] and inv["mh_workers_agree"]
+        assert inv["mh_commit_window_all_or_nothing"]
+        assert inv["mh_commit_window_recovered"]
+        assert inv["mh_elastic_mesh_to_single"]
+        assert inv["mh_elastic_mesh_to_mesh"]
+        results = payload["results"]
+        assert results["kill_recover"]["lost_steps"] >= 0
+        assert results["commit_window"]["stage_leftovers"]

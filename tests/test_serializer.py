@@ -233,3 +233,99 @@ class TestSerializer:
                 zout.writestr(name, data)
         with pytest.raises(ValueError, match="newer"):
             read_model(bad)
+
+
+class TestStateShards:
+    """The mesh checkpoint plane's shard format (resilience/mesh.py):
+    deterministic key partition, self-verifying per-shard zips, and the
+    merge property elastic restore rests on."""
+
+    def test_shard_keys_partition_is_exact(self):
+        from gan_deeplearning4j_tpu.utils.serializer import shard_keys
+
+        keys = [f"m/params/l{i}/w" for i in range(17)]
+        for count in (1, 2, 4, 5):
+            shards = [shard_keys(keys, k, count) for k in range(count)]
+            merged = sorted(k for s in shards for k in s)
+            assert merged == sorted(keys)  # disjoint AND covering
+            # balanced: no shard more than one key heavier than another
+            sizes = [len(s) for s in shards]
+            assert max(sizes) - min(sizes) <= 1
+        # deterministic regardless of input order
+        assert shard_keys(reversed(keys), 1, 3) == shard_keys(keys, 1, 3)
+
+    def test_shard_keys_validation(self):
+        from gan_deeplearning4j_tpu.utils.serializer import shard_keys
+
+        with pytest.raises(ValueError):
+            shard_keys(["a"], 0, 0)
+        with pytest.raises(ValueError):
+            shard_keys(["a"], 2, 2)
+
+    def test_shard_round_trip_including_bf16(self, tmp_path):
+        from gan_deeplearning4j_tpu.utils.serializer import (
+            read_state_shard,
+            write_state_shard,
+        )
+
+        flat = {
+            "dis/params/w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "dis/updater/w/cache": jnp.asarray([1.5, 2.5], jnp.bfloat16),
+            "dis/step": np.int32(7),
+        }
+        path = os.path.join(tmp_path, "shard.zip")
+        write_state_shard(path, flat, meta={"shard_index": 0,
+                                            "shard_count": 2,
+                                            "total_keys": 6})
+        back, meta = read_state_shard(path)
+        assert sorted(back) == sorted(flat)
+        assert back["dis/updater/w/cache"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(back["dis/params/w"]), np.asarray(flat["dis/params/w"]))
+        np.testing.assert_array_equal(
+            np.asarray(back["dis/updater/w/cache"]).view(np.uint16),
+            np.asarray(flat["dis/updater/w/cache"]).view(np.uint16))
+        assert meta["shard_index"] == 0 and meta["shard_count"] == 2
+        assert meta["total_keys"] == 6
+
+    def test_shard_corruption_rejected(self, tmp_path):
+        from gan_deeplearning4j_tpu.utils.serializer import (
+            read_state_shard,
+            write_state_shard,
+        )
+
+        path = os.path.join(tmp_path, "shard.zip")
+        write_state_shard(path, {"x": np.zeros(64, np.float32)}, meta={})
+        import json
+        import zipfile
+
+        bad = os.path.join(tmp_path, "bad.zip")
+        with zipfile.ZipFile(path) as zin, zipfile.ZipFile(bad, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == "arrays.npz":
+                    data = data[:-1] + bytes([data[-1] ^ 0xFF])
+                zout.writestr(name, data)
+        with pytest.raises(ValueError, match="digest"):
+            read_state_shard(bad)
+        # truncation of the zip container itself
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        torn = os.path.join(tmp_path, "torn.zip")
+        with open(torn, "wb") as fh:
+            fh.write(blob[: len(blob) // 2])
+        with pytest.raises(ValueError):
+            read_state_shard(torn)
+        # future format version refused
+        future = os.path.join(tmp_path, "future.zip")
+        with zipfile.ZipFile(path) as zin, \
+                zipfile.ZipFile(future, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == "meta.json":
+                    meta = json.loads(data)
+                    meta["format_version"] = 999
+                    data = json.dumps(meta).encode()
+                zout.writestr(name, data)
+        with pytest.raises(ValueError, match="newer"):
+            read_state_shard(future)
