@@ -38,6 +38,9 @@ from gan_deeplearning4j_tpu.analysis.rules.net_timeout import (
 from gan_deeplearning4j_tpu.analysis.rules.state_spec import (
     ShardedStateSpecMismatch,
 )
+from gan_deeplearning4j_tpu.analysis.rules.prefetch_callback import (
+    PrefetchCallbackInTimedRegion,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -58,6 +61,7 @@ RULES = [
     SwapSeamUnguardedAccess(),
     UnboundedNetworkCall(),
     ShardedStateSpecMismatch(),
+    PrefetchCallbackInTimedRegion(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

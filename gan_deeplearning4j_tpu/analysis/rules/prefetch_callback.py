@@ -1,0 +1,195 @@
+"""JG019 — host callback reached from a prefetch/data-pipeline callback
+consumed inside a timed region.
+
+JG009 catches a host callback the timed loop CALLS — directly or through
+the call graph. It cannot catch the indirect shape the streaming input
+pipeline introduces: a callable handed to a prefetch/pipeline object at
+CONSTRUCTION (``DevicePrefetchIterator(inner, transform=log_row)``) fires
+later, from inside ``next()``/``has_next()`` refills, while the training
+window is being timed — the loop's own call graph never mentions the
+callback, so JG009 is structurally blind to it. The measured symptom is
+identical (a ~70 ms host round-trip billed to the step time, PROFILE.md
+round 3) but the edit distance is worse: the offending line is the
+pipeline construction, screens away from the loop it poisons.
+
+The rule is scope-local over the construction and flow-free on purpose:
+
+1. a *pipeline construction* is a call whose callee's terminal identifier
+   contains ``prefetch`` or ``pipeline`` (case-insensitive; the repo seam
+   is :class:`~gan_deeplearning4j_tpu.data.iterator.DevicePrefetchIterator`
+   and its ``transform=`` hook), assigned whole to one name;
+2. a *tainted callback* among its arguments is a lambda literal whose body
+   performs a host callback, or a name whose function def (same module)
+   reaches one — directly or through the project index's transitive
+   callback taint;
+3. a *timed region* is JG009's: a loop that reads a wall clock, or the
+   span between a function body's first and last clock reads;
+4. the finding fires where the tainted pipeline is CONSUMED inside a
+   timed region — a method call on the variable (``it.next()``,
+   ``it.next_window(k)``), iteration over it (``for batch in it:`` or a
+   comprehension), or the variable passed into another call
+   (``run(exp, it)``).
+
+True negatives: pure host-side transforms (numpy math), tainted pipelines
+consumed only outside timed regions, pipeline constructors with no
+function-valued arguments, and host callbacks invoked directly by the
+loop (JG009's finding, not this rule's).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from gan_deeplearning4j_tpu.analysis import _common
+from gan_deeplearning4j_tpu.analysis.rules.callbacks import _clock_lines
+
+_SEAM_TOKENS = ("prefetch", "pipeline")
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class PrefetchCallbackInTimedRegion:
+    code = "JG019"
+    name = "prefetch-callback-in-timed-region"
+    summary = ("host callback reached from a prefetch/data-pipeline "
+               "callback consumed inside a timed region")
+
+    # -- taint ------------------------------------------------------------
+    def _direct_callback(self, mod, body) -> bool:
+        for n in ast.walk(body) if isinstance(body, ast.AST) else body:
+            if isinstance(n, ast.Call) \
+                    and mod.resolve(n.func) in _common.HOST_CALLBACKS:
+                return True
+        return False
+
+    def _tainted_callable(self, mod, defs: Dict[str, ast.AST],
+                          node: ast.AST) -> bool:
+        """Is this argument a function value that reaches a host callback?"""
+        if isinstance(node, ast.Lambda):
+            return self._direct_callback(mod, node.body)
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        if name is not None and name in defs:
+            fn = defs[name]
+            if self._direct_callback(mod, fn):
+                return True
+        # transitive: the project index's callback taint closure covers
+        # helpers-of-helpers and cross-module callbacks
+        if mod.project is not None and isinstance(
+                node, (ast.Name, ast.Attribute)):
+            summary = mod.project.resolve_function(mod, node)
+            if summary is not None and mod.project.callback_tainted(summary):
+                return True
+        return False
+
+    def _local_defs(self, mod) -> Dict[str, ast.AST]:
+        """name -> def/lambda node for every function defined in the
+        module (including ``f = lambda ...`` binds)."""
+        defs: Dict[str, ast.AST] = {}
+        for n in ast.walk(mod.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(n.name, n)
+            elif (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Lambda)):
+                defs.setdefault(n.targets[0].id, n.value)
+        return defs
+
+    def _tainted_pipelines(self, mod) -> Dict[str, ast.Call]:
+        """var name -> construction call, for every pipeline built with a
+        callback that reaches a host callback."""
+        defs = self._local_defs(mod)
+        out: Dict[str, ast.Call] = {}
+        for n in ast.walk(mod.tree):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            callee = _terminal(n.value.func)
+            if callee is None or not any(
+                    tok in callee.lower() for tok in _SEAM_TOKENS):
+                continue
+            for _, arg in _common.call_args_with_keywords(n.value):
+                if self._tainted_callable(mod, defs, arg):
+                    out[n.targets[0].id] = n.value
+                    break
+        return out
+
+    # -- regions (JG009's shapes) -----------------------------------------
+    def _regions(self, mod):
+        for loop in _common.iter_loops(mod.tree):
+            if _clock_lines(loop, mod):
+                yield "timed loop", list(_common.walk_excluding_defs(loop))
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if not body:
+                continue
+            lines = _clock_lines(body, mod)
+            if len(lines) < 2:
+                continue
+            lo, hi = lines[0], lines[-1]
+            yield "timed span", [
+                n for n in _common.walk_excluding_defs(body)
+                if lo <= getattr(n, "lineno", 0) <= hi
+            ]
+
+    def check(self, mod):
+        pipelines = self._tainted_pipelines(mod)
+        if not pipelines:
+            return
+        flagged = set()  # one finding per pipeline variable: the defect
+        # is the construction, however many consumption sites it has
+        for where, nodes in self._regions(mod):
+            for call in nodes:
+                var = None
+                if isinstance(call, (ast.For, ast.AsyncFor)) and isinstance(
+                        call.iter, ast.Name) and call.iter.id in pipelines:
+                    # the iterator protocol: `for batch in it:` — the most
+                    # idiomatic consumption of the seam
+                    var = call.iter.id
+                elif isinstance(call, (ast.GeneratorExp, ast.ListComp,
+                                       ast.SetComp, ast.DictComp)):
+                    for gen in call.generators:
+                        if isinstance(gen.iter, ast.Name) \
+                                and gen.iter.id in pipelines:
+                            var = gen.iter.id
+                            break
+                elif isinstance(call, ast.Call):
+                    # it.next() / it.has_next() / it.next_window(k)
+                    if isinstance(call.func, ast.Attribute) and isinstance(
+                            call.func.value, ast.Name) \
+                            and call.func.value.id in pipelines:
+                        var = call.func.value.id
+                    else:
+                        # the pipeline handed to a consumer: run(exp, it)
+                        for _, arg in _common.call_args_with_keywords(call):
+                            if isinstance(arg, ast.Name) \
+                                    and arg.id in pipelines:
+                                var = arg.id
+                                break
+                if var is None or var in flagged:
+                    continue
+                flagged.add(var)
+                ctor = pipelines[var]
+                # anchored at the CONSTRUCTION — the actionable line, and
+                # a stable anchor however many consumption sites exist
+                yield mod.finding(
+                    self.code,
+                    f"`{var}` is consumed inside a {where} (line "
+                    f"{call.lineno}), and its construction installs a "
+                    f"callback that performs a host callback "
+                    f"(io_callback/pure_callback/jax.debug.*) — every "
+                    f"prefetch refill round-trips through the host inside "
+                    f"the measurement (~70 ms through the tunnel); strip "
+                    f"the callback or move the pipeline's timed "
+                    f"consumption out of the clocked region",
+                    ctor,
+                ), ctor

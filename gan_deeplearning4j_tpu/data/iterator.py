@@ -283,19 +283,33 @@ class DevicePrefetchIterator(DataSetIterator):
     ``NamedSharding(mesh, P("data"))`` to land batches pre-sharded across the
     mesh — the device-resident replacement for the reference's prefetch knob
     (``workerPrefetchNumBatches``, dl4jGANComputerVision.java:328).
+
+    ``transform`` is an optional host-side per-batch hook
+    (``DataSet -> DataSet``) applied BEFORE device placement —
+    normalization/augmentation for the streaming-pipeline direction
+    without touching the step loop. It runs during prefetch refills, i.e.
+    inside whatever region is consuming the iterator: a transform that
+    performs a host callback (``jax.debug.*``, ``io_callback``) poisons
+    every timed window it refills under — jaxlint JG019 polices exactly
+    that shape (docs/STATIC_ANALYSIS.md).
     """
 
-    def __init__(self, inner: DataSetIterator, depth: int = 2, sharding=None):
+    def __init__(self, inner: DataSetIterator, depth: int = 2, sharding=None,
+                 transform=None):
         if depth < 1:
             raise ValueError("prefetch depth must be >= 1")
         self.inner = inner
         self.depth = depth
         self.sharding = sharding
+        self.transform = transform
         self._queue: deque = deque()
 
     def _fill(self) -> None:
         while len(self._queue) < self.depth and self.inner.has_next():
-            self._queue.append(self.inner.next().to_device(self.sharding))
+            batch = self.inner.next()
+            if self.transform is not None:
+                batch = self.transform(batch)
+            self._queue.append(batch.to_device(self.sharding))
 
     def has_next(self) -> bool:
         self._fill()

@@ -86,6 +86,15 @@ class ExperimentConfig:
     # "param_averaging": k-step synchronous parameter averaging (reference
     # semantics, averagingFrequency=10 :326).
     distributed: str = "none"
+    # Cross-replica weight-update sharding (parallel/update_sharding.py):
+    # with distributed="pmean", partition the flat param/updater key space
+    # across the data axis (the mesh checkpoint plane's round-robin, so
+    # checkpoint shard files map 1:1 onto compute shards), reduce-scatter
+    # grads, apply the optimizer update only for owned keys (updater state
+    # resident at ~1/N per device), and all-gather the params. Identical
+    # math to the replicated update — proven bit-exact on the CPU backend
+    # by the parity tests (docs/RESILIENCE.md, update-sharding section).
+    update_sharding: bool = False
     averaging_frequency: int = 10
     batch_size_per_worker: int = 200
     prefetch: int = 0  # workerPrefetchNumBatches (:328); >0 enables device prefetch
@@ -134,6 +143,18 @@ class ExperimentConfig:
             )
         if self.distributed not in ("none", "pmean", "param_averaging"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
+        if self.update_sharding and self.distributed != "pmean":
+            # param_averaging keeps per-worker DIVERGENT updater state
+            # between averaging boundaries — there is no replicated update
+            # to shard; single-chip has no data axis. Only the per-step
+            # gradient-sync mode has the replicated-update redundancy this
+            # optimization removes.
+            raise ValueError(
+                "update_sharding requires distributed='pmean' (the per-step "
+                "gradient-sync mesh path); param_averaging workers hold "
+                "divergent local updater state and 'none' has no mesh axis "
+                "to shard over"
+            )
         if self.dis_lr_decay_every < 0:
             raise ValueError("dis_lr_decay_every must be >= 0 (0 = off)")
         if self.checkpoint_every < 1:
@@ -160,6 +181,12 @@ class ExperimentConfig:
                     "wgan_gp supports distributed='pmean' (per-step sync over "
                     "the mesh); k-step parameter averaging is a reference-"
                     "parity mode for the XENT families"
+                )
+            if self.update_sharding:
+                raise ValueError(
+                    "update_sharding is implemented for the GraphTrainer "
+                    "families; the WGAN-GP trainer keeps the replicated "
+                    "update (its critic-round program is its own)"
                 )
         return self
 

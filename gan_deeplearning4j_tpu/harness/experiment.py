@@ -196,6 +196,13 @@ class GanExperiment:
             if self.cv_state is not None:
                 self.cv_state = cast(self.cv_state)
             self.gen_params = cast(self.gen_params)
+        # Cross-replica weight-update sharding (the ROADMAP mesh item's
+        # compute half): installed AFTER every state exists, because the
+        # ownership partition is taken over the FULL _flat_state() key
+        # namespace — that is what makes compute shard k own exactly the
+        # updater keys checkpoint shard k writes (no format change).
+        if cfg.update_sharding:
+            self._enable_update_sharding()
         self._gen_fwd = jax.jit(lambda p, z: self.gen.output(p, z, train=False))
 
         # label-softening noise, sampled ONCE like the reference (:404-406)
@@ -239,6 +246,84 @@ class GanExperiment:
             # _build_multi_iteration in place of the fused GraphTrainer body
             self._fused_body = self._build_fused_avg_body()
             self._supports_device_loop = True
+
+    # -- update sharding (parallel/update_sharding.py) -------------------
+    def _enable_update_sharding(self) -> None:
+        """Partition every trainer's update computation + updater state
+        over the mesh data axis. The global key list is the sorted
+        ``_flat_state()`` namespace — the same partition input the mesh
+        checkpoint plane's ``serializer.shard_keys`` uses, so compute and
+        checkpoint shards coincide key-for-key (RmsProp/stateless specs;
+        multi-field state is owned as a unit by its first key's shard)."""
+        from gan_deeplearning4j_tpu.parallel.update_sharding import (
+            UpdateShardingPlan,
+        )
+        from gan_deeplearning4j_tpu.utils.serializer import _element_count
+
+        global_keys = {k: _element_count(v)
+                       for k, v in self._flat_state().items()}
+        models = [("dis", self.dis_trainer, "dis_state"),
+                  ("gan", self.gan_trainer, "gan_state")]
+        if self.cv is not None:
+            models.append(("CV", self.cv_trainer, "cv_state"))
+        for name, trainer, attr in models:
+            state = getattr(self, attr)
+            trainer.enable_update_sharding(UpdateShardingPlan(
+                trainer.graph, trainer.optimizer, state.params, self.mesh,
+                data_axis=trainer.data_axis, model_name=name,
+                global_keys=global_keys,
+            ))
+            setattr(self, attr, trainer.place_state(TrainState(
+                state.params,
+                trainer.plan.pack_state(state.opt_state),
+                state.step,
+            )))
+
+    def _tree_state(self, trainer, state: TrainState) -> TrainState:
+        """The canonical tree-form view of a TrainState — what checkpoints
+        serialize and digests are taken over. Identity when the updater
+        state is already a tree; under update sharding the packed rows
+        are unpacked (a gather of this process's own devices)."""
+        from gan_deeplearning4j_tpu.parallel.update_sharding import (
+            PackedOptState,
+        )
+
+        if isinstance(state.opt_state, PackedOptState):
+            return TrainState(
+                state.params,
+                trainer.plan.unpack_state(state.opt_state),
+                state.step,
+            )
+        return state
+
+    def digest_states(self) -> Dict:
+        """Canonical (tree-form) states for bit-exactness digests — one
+        definition shared by the resilience supervisor and the parity
+        tests, identical across replicated and update-sharded modes."""
+        out = {
+            "dis": self._tree_state(self.dis_trainer, self.dis_state),
+            "gan": self._tree_state(self.gan_trainer, self.gan_state),
+            "gen": self.gen_params,
+        }
+        if self.cv is not None:
+            out["CV"] = self._tree_state(self.cv_trainer, self.cv_state)
+        return out
+
+    def _state_jit_sharding(self, trainer, state):
+        """jit in/out sharding for one model state: a replicated prefix
+        normally; the packed-rows placement pytree under update
+        sharding."""
+        rep = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec())
+        from gan_deeplearning4j_tpu.parallel.update_sharding import (
+            PackedOptState,
+        )
+        from gan_deeplearning4j_tpu.parallel.trainer import state_shardings
+
+        if isinstance(state, TrainState) and isinstance(
+                state.opt_state, PackedOptState):
+            return state_shardings(state, trainer.plan)
+        return rep
 
     # ------------------------------------------------------------------
     def _make_trainer(self, graph: ComputationGraph):
@@ -377,11 +462,22 @@ class GanExperiment:
             data = jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec("data")
             )
-            kwargs["in_shardings"] = (rep,) * 4 + (data,) * 4
-            kwargs["out_shardings"] = (rep,) * 7
+            states = self._fused_state_shardings()
+            kwargs["in_shardings"] = states + (rep,) + (data,) * 4
+            kwargs["out_shardings"] = states + (rep,) * 4
         # keep the traceable body around: _build_multi_iteration scans it
         self._fused_body = fused
         return jax.jit(fused, **kwargs)
+
+    def _fused_state_shardings(self):
+        """in/out shardings of the three carried TrainStates — replicated
+        prefixes normally, the packed-rows pytrees under update sharding
+        (gen_params stays a replicated prefix either way)."""
+        return (
+            self._state_jit_sharding(self.dis_trainer, self.dis_state),
+            self._state_jit_sharding(self.gan_trainer, self.gan_state),
+            self._state_jit_sharding(self.cv_trainer, self.cv_state),
+        )
 
     def _build_fused_avg_body(self):
         """The alternating iteration under FAITHFUL parameter averaging as
@@ -541,8 +637,9 @@ class GanExperiment:
             data = jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec("data")
             )
-            kwargs["in_shardings"] = (rep,) * 4 + (stacked,) * 2 + (data,) * 2
-            kwargs["out_shardings"] = (rep,) * 4 + (rep,) * 3
+            states = self._fused_state_shardings()
+            kwargs["in_shardings"] = states + (rep,) + (stacked,) * 2 + (data,) * 2
+            kwargs["out_shardings"] = states + (rep,) + (rep,) * 3
         return jax.jit(multi, **kwargs)
 
     def _eps_slices(self, b: int):
@@ -811,12 +908,12 @@ class GanExperiment:
         os.makedirs(directory, exist_ok=True)
         out = []
         models = [
-            ("dis", self.dis, self.dis_state),
-            ("gan", self.gan, self.gan_state),
+            ("dis", self.dis, self._tree_state(self.dis_trainer, self.dis_state)),
+            ("gan", self.gan, self._tree_state(self.gan_trainer, self.gan_state)),
             ("gen", self.gen, self.gen_params),
         ]
         if self.cv is not None:
-            models.append(("CV", self.cv, self.cv_state))
+            models.append(("CV", self.cv, self._tree_state(self.cv_trainer, self.cv_state)))
         for name, graph, state in models:
             path = os.path.join(directory, f"{cfg.file_prefix}_{name}_model.zip")
             write_model(path, graph, state, save_updater=True)
@@ -831,18 +928,21 @@ class GanExperiment:
         partition without communicating."""
         from gan_deeplearning4j_tpu.utils.serializer import _flatten
 
+        dis = self._tree_state(self.dis_trainer, self.dis_state)
+        gan = self._tree_state(self.gan_trainer, self.gan_state)
         flat: Dict = {}
-        _flatten("dis/params", self.dis_state.params, flat)
-        _flatten("dis/updater", self.dis_state.opt_state, flat)
-        flat["dis/step"] = self.dis_state.step
-        _flatten("gan/params", self.gan_state.params, flat)
-        _flatten("gan/updater", self.gan_state.opt_state, flat)
-        flat["gan/step"] = self.gan_state.step
+        _flatten("dis/params", dis.params, flat)
+        _flatten("dis/updater", dis.opt_state, flat)
+        flat["dis/step"] = dis.step
+        _flatten("gan/params", gan.params, flat)
+        _flatten("gan/updater", gan.opt_state, flat)
+        flat["gan/step"] = gan.step
         _flatten("gen/params", self.gen_params, flat)
         if self.cv is not None:
-            _flatten("CV/params", self.cv_state.params, flat)
-            _flatten("CV/updater", self.cv_state.opt_state, flat)
-            flat["CV/step"] = self.cv_state.step
+            cv = self._tree_state(self.cv_trainer, self.cv_state)
+            _flatten("CV/params", cv.params, flat)
+            _flatten("CV/updater", cv.opt_state, flat)
+            flat["CV/step"] = cv.step
         return flat
 
     def save_model_shard(self, directory: str, shard_index: int,
@@ -870,6 +970,11 @@ class GanExperiment:
                 "shard_count": int(shard_count),
                 "step": int(self.gan_state.step),
                 "total_keys": len(flat),
+                # compute-side update sharding: when on, this worker's
+                # resident updater rows are exactly this shard's updater
+                # keys — the 1:1 compute↔checkpoint mapping the drill's
+                # shard-mismatch messages surface
+                "update_sharding": bool(self.config.update_sharding),
             },
         )
         return [name]
@@ -994,12 +1099,23 @@ class GanExperiment:
                 return jax.device_put(state, NamedSharding(self.mesh, PartitionSpec()))
             return state
 
-        def _stored(state):
+        def _stored(state, trainer=None):
             # checkpoints written under bf16 storage restore as bf16 already
             # (dtype-tagged); an f32 checkpoint resumed under param_dtype=bf16
-            # gets cast on entry, mirroring __init__
+            # gets cast on entry, mirroring __init__. Under update sharding
+            # the tree-form updater state is re-packed onto THIS mesh's
+            # partition — a pure re-grouping, so restores are bit-exact
+            # regardless of the writer's mesh shape (or compute mode).
             if self._param_dtype is not None:
                 state = self._cast_state(state)
+            if (trainer is not None
+                    and getattr(trainer, "shard_updates", False)
+                    and isinstance(state, TrainState)):
+                return trainer.place_state(TrainState(
+                    state.params,
+                    trainer.plan.pack_state(state.opt_state),
+                    state.step,
+                ))
             return _placed(state)
 
         shard_files = sorted(
@@ -1010,14 +1126,17 @@ class GanExperiment:
             return self._load_models_sharded(directory, shard_files, _stored)
 
         self.dis_state = _stored(
-            ModelSerializer.restore_train_state(f"{prefix}_dis_model.zip", self.dis_trainer)
+            ModelSerializer.restore_train_state(f"{prefix}_dis_model.zip", self.dis_trainer),
+            self.dis_trainer,
         )
         self.gan_state = _stored(
-            ModelSerializer.restore_train_state(f"{prefix}_gan_model.zip", self.gan_trainer)
+            ModelSerializer.restore_train_state(f"{prefix}_gan_model.zip", self.gan_trainer),
+            self.gan_trainer,
         )
         if self.cv is not None:
             self.cv_state = _stored(
-                ModelSerializer.restore_train_state(f"{prefix}_CV_model.zip", self.cv_trainer)
+                ModelSerializer.restore_train_state(f"{prefix}_CV_model.zip", self.cv_trainer),
+                self.cv_trainer,
             )
         _, gen_params, _, _ = read_model(f"{prefix}_gen_model.zip", load_updater=False)
         self.gen_params = _stored(gen_params)
@@ -1071,15 +1190,19 @@ class GanExperiment:
             params = _unflatten(flat, f"{model}/params")
             opt_state = _unflatten(flat, f"{model}/updater")
             if not opt_state:
-                opt_state = trainer.optimizer.init(params)
+                opt = getattr(trainer.optimizer, "base", trainer.optimizer)
+                opt_state = opt.init(params)
             step = jnp.asarray(int(np.asarray(flat[f"{model}/step"])),
                                jnp.int32)
             return TrainState(params, opt_state, step)
 
-        self.dis_state = stored(train_state("dis", self.dis_trainer))
-        self.gan_state = stored(train_state("gan", self.gan_trainer))
+        self.dis_state = stored(train_state("dis", self.dis_trainer),
+                                self.dis_trainer)
+        self.gan_state = stored(train_state("gan", self.gan_trainer),
+                                self.gan_trainer)
         if self.cv is not None:
-            self.cv_state = stored(train_state("CV", self.cv_trainer))
+            self.cv_state = stored(train_state("CV", self.cv_trainer),
+                                   self.cv_trainer)
         self.gen_params = stored(_unflatten(flat, "gen/params"))
         self.batch_counter = int(self.gan_state.step)
         return self.batch_counter

@@ -22,8 +22,9 @@ before the updater sees it.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Iterable, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from gan_deeplearning4j_tpu.ops import clipping
@@ -38,22 +39,55 @@ class GraphOptimizer:
         self._clip = graph.config.gradient_clip
         self._clip_value = graph.config.gradient_clip_value
 
+    @property
+    def updaters(self) -> Dict:
+        """Per-layer updater specs — the update-sharding plan's group key."""
+        return self._updaters
+
     def trainable(self, layer: str, pname: str) -> bool:
         return (
             layer in self._updaters
             and self._roles.get(layer, {}).get(pname) != "state"
         )
 
-    def init(self, params: Dict) -> Dict:
-        """Updater state tree: {layer: {param: state_dict}} for trainable params."""
+    def init(self, params: Dict, keys: Optional[Iterable[Tuple[str, str]]] = None) -> Dict:
+        """Updater state tree: {layer: {param: state_dict}} for trainable
+        params. ``keys`` restricts init to a shard slice of (layer, pname)
+        pairs — the tree-granularity half of the shard-slice init surface
+        (the packed half is ``UpdaterSpec.init_state_packed``, which the
+        update-sharding plan consumes). Nothing in the restore paths needs
+        it today: elastic restores re-init missing updaters WHOLE and
+        re-pack, so this exists for callers that want a per-shard tree
+        without materializing the rest."""
+        wanted = None if keys is None else set(keys)
         state: Dict = {}
         for layer, updater in self._updaters.items():
             state[layer] = {
                 pname: updater.init_state(p)
                 for pname, p in params[layer].items()
                 if self.trainable(layer, pname)
+                and (wanted is None or (layer, pname) in wanted)
             }
         return state
+
+    def state_structs(self, params: Dict) -> Dict:
+        """The updater state tree as ShapeDtypeStructs (no buffers) —
+        what the update-sharding plan derives its packed layout and flat
+        key namespace from."""
+        return jax.eval_shape(self.init, params)
+
+    def clip_grads(self, grads: Dict) -> Dict:
+        """The graph-config gradient normalization (step 1 of :meth:`step`),
+        shared verbatim by the sharded update path — clipping happens on
+        the replicated gradients in both modes, so the per-element update
+        inputs are identical."""
+        if self._clip == "elementwise":
+            return clipping.clip_elementwise(grads, self._clip_value)
+        if self._clip == "global_norm":
+            return clipping.clip_by_global_norm(grads, self._clip_value)
+        if self._clip is not None:
+            raise ValueError(f"unknown gradient_clip {self._clip!r}")
+        return grads
 
     def step(self, params: Dict, grads: Dict, opt_state: Dict,
              lr_scale=None) -> Tuple[Dict, Dict]:
@@ -66,12 +100,7 @@ class GraphOptimizer:
         exactly an effective-LR rescale — the mechanism behind the dis-LR
         decay schedule (ExperimentConfig.dis_lr_decay_*) without baking the
         rate into the compiled program."""
-        if self._clip == "elementwise":
-            grads = clipping.clip_elementwise(grads, self._clip_value)
-        elif self._clip == "global_norm":
-            grads = clipping.clip_by_global_norm(grads, self._clip_value)
-        elif self._clip is not None:
-            raise ValueError(f"unknown gradient_clip {self._clip!r}")
+        grads = self.clip_grads(grads)
 
         new_params = dict(params)
         new_state = dict(opt_state)

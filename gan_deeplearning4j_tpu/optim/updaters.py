@@ -40,8 +40,25 @@ class UpdaterSpec:
         return {}
 
     def apply(self, state: Dict[str, Any], grad, param) -> Tuple[Any, Dict[str, Any]]:
-        """Returns (delta_to_subtract, new_state)."""
+        """Returns (delta_to_subtract, new_state). Every in-tree rule is
+        elementwise with scalar hyperparameters, so ``apply`` works
+        unchanged on any shape — including the packed 1-D/row slices the
+        update-sharding plan feeds it."""
         raise NotImplementedError
+
+    def init_state_packed(self, packed_param) -> Dict[str, Any]:
+        """State for a packed shard slice of trainable elements (the
+        update-sharding layout): the elementwise image of
+        :meth:`init_state`, with scalar slots broadcast per element
+        (Adam's ``t``) so the whole update stays elementwise. Values are
+        bit-identical to packing the tree-form init."""
+        out: Dict[str, Any] = {}
+        for field, value in self.init_state(packed_param).items():
+            value = jnp.asarray(value)
+            if value.ndim == 0:
+                value = jnp.broadcast_to(value, jnp.shape(packed_param))
+            out[field] = value
+        return out
 
     def with_learning_rate(self, lr: float) -> "UpdaterSpec":
         return dataclasses.replace(self, learning_rate=lr)

@@ -17,9 +17,27 @@ XLA collectives:
   ``averaging_frequency`` minibatches locally (divergent local params),
   then params *and updater state* are arithmetically averaged with
   ``lax.pmean`` (the map-reduce of gan.ipynb cell 3).
+- :mod:`~gan_deeplearning4j_tpu.parallel.update_sharding` — cross-replica
+  weight-update sharding for :class:`GraphTrainer` (``shard_updates=``):
+  reduce-scatter grads, apply the optimizer update only for the keys each
+  shard owns (updater state resident at ~1/N per device), all-gather the
+  params. The key partition is the mesh checkpoint plane's round-robin,
+  so checkpoint shard files map 1:1 onto compute shards.
 """
 
 from gan_deeplearning4j_tpu.parallel.trainer import GraphTrainer, TrainState
 from gan_deeplearning4j_tpu.parallel.param_averaging import ParameterAveragingTrainer
+from gan_deeplearning4j_tpu.parallel.update_sharding import (
+    PackedOptState,
+    ShardedGraphOptimizer,
+    UpdateShardingPlan,
+)
 
-__all__ = ["GraphTrainer", "TrainState", "ParameterAveragingTrainer"]
+__all__ = [
+    "GraphTrainer",
+    "TrainState",
+    "ParameterAveragingTrainer",
+    "PackedOptState",
+    "ShardedGraphOptimizer",
+    "UpdateShardingPlan",
+]

@@ -16,6 +16,14 @@ NOT equivalent to per-step gradient all-reduce — workers' params diverge
 for k local RmsProp steps before the mean. Both modes exist here:
 per-step gradient sync is :class:`~gan_deeplearning4j_tpu.parallel.trainer.
 GraphTrainer` on a mesh; this class is the faithful k-step averaging.
+
+Update-sharding note (parallel/update_sharding.py): cross-replica
+weight-update sharding does NOT apply to this trainer, by construction —
+between averaging boundaries every worker holds deliberately DIVERGENT
+local updater state (that divergence is the algorithm), so there is no
+replicated, redundantly-applied update to shard. The config layer rejects
+``update_sharding=True`` with ``distributed='param_averaging'``; only the
+per-step ``pmean`` path has the redundancy the optimization removes.
 """
 
 from __future__ import annotations

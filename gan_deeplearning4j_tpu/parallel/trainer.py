@@ -63,6 +63,19 @@ def make_train_state(
     return state
 
 
+def state_shardings(state: TrainState, plan) -> TrainState:
+    """The placement pytree for a TrainState under update sharding:
+    params/step replicated, the packed updater rows split over the data
+    axis (row *k* on shard *k*). Used both for ``device_put`` placement
+    and as jit in/out shardings."""
+    rep = plan.replicated_sharding()
+    return TrainState(
+        jax.tree_util.tree_map(lambda _: rep, state.params),
+        plan.state_shardings(),
+        rep,
+    )
+
+
 class GraphTrainer:
     """Single-chip or data-parallel trainer for one ComputationGraph.
 
@@ -80,17 +93,74 @@ class GraphTrainer:
         mesh: Optional[jax.sharding.Mesh] = None,
         data_axis: str = "data",
         donate: bool = True,
+        shard_updates: bool = False,
+        model_name: str = "model",
+        global_state_keys=None,
     ):
         self.graph = graph
         self.optimizer = GraphOptimizer(graph)
         self.mesh = mesh
         self.data_axis = data_axis
-        self._step_fn = self._build_step(donate)
+        self._donate = donate
+        if shard_updates and mesh is None:
+            raise ValueError("shard_updates requires a mesh — there is no "
+                             "data axis to shard the update over")
+        self.shard_updates = shard_updates
+        self.model_name = model_name
+        self._global_state_keys = global_state_keys
+        self.plan = None
+        # the sharded step's shardings need a plan, and the plan needs
+        # param shapes — defer the jit build to the first train_step
+        self._step_fn = None if shard_updates else self._build_step(donate)
         self._eval_fn = None
 
     # -- state --------------------------------------------------------------
     def init_state(self, seed: Optional[int] = None, params: Optional[Dict] = None) -> TrainState:
-        return make_train_state(self.graph, self.optimizer, self.mesh, seed, params)
+        if not self.shard_updates:
+            return make_train_state(self.graph, self.optimizer, self.mesh, seed, params)
+        if params is None:
+            params = self.graph.init(seed)
+        self._ensure_plan(params)
+        return self.place_state(TrainState(
+            params=params,
+            opt_state=self.optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        ))
+
+    # -- update sharding -----------------------------------------------------
+    def _ensure_plan(self, params: Dict) -> None:
+        if self.plan is not None:
+            return
+        from gan_deeplearning4j_tpu.parallel.update_sharding import (
+            UpdateShardingPlan,
+        )
+
+        self.enable_update_sharding(UpdateShardingPlan(
+            self.graph, self.optimizer, params, self.mesh,
+            data_axis=self.data_axis, model_name=self.model_name,
+            global_keys=self._global_state_keys,
+        ))
+
+    def enable_update_sharding(self, plan) -> None:
+        """Install an :class:`UpdateShardingPlan` (the experiment passes
+        one built over its full multi-model key namespace; standalone use
+        derives a single-model plan lazily). Swaps the optimizer for the
+        sharded drop-in and invalidates the compiled step."""
+        from gan_deeplearning4j_tpu.parallel.update_sharding import (
+            ShardedGraphOptimizer,
+        )
+
+        if isinstance(self.optimizer, ShardedGraphOptimizer):
+            self.optimizer = self.optimizer.base
+        self.plan = plan
+        self.optimizer = ShardedGraphOptimizer(plan)
+        self.shard_updates = True
+        self._step_fn = None
+
+    def place_state(self, state: TrainState) -> TrainState:
+        """Place a (tree-params, packed-updater) state: params/step
+        replicated, packed rows over the data axis."""
+        return jax.device_put(state, state_shardings(state, self.plan))
 
     def _replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
@@ -110,7 +180,7 @@ class GraphTrainer:
         )
         return loss, new_params
 
-    def _build_step(self, donate: bool):
+    def _build_step(self, donate: bool, state: Optional[TrainState] = None):
         def step(state: TrainState, features, labels, rng) -> Tuple[TrainState, jnp.ndarray]:
             # Distinct per-step randomness by construction: the step counter
             # is folded into whatever key the caller supplied, so a caller
@@ -132,8 +202,13 @@ class GraphTrainer:
         if self.mesh is not None:
             rep = self._replicated()
             data = NamedSharding(self.mesh, P(self.data_axis))
-            kwargs["in_shardings"] = (rep, data, data, rep)
-            kwargs["out_shardings"] = (rep, rep)
+            if self.shard_updates and state is not None:
+                st = state_shardings(state, self.plan)
+                kwargs["in_shardings"] = (st, data, data, rep)
+                kwargs["out_shardings"] = (st, rep)
+            else:
+                kwargs["in_shardings"] = (rep, data, data, rep)
+                kwargs["out_shardings"] = (rep, rep)
         return jax.jit(step, **kwargs)
 
     def train_step(self, state: TrainState, features, labels, rng=None) -> Tuple[TrainState, jnp.ndarray]:
@@ -142,6 +217,8 @@ class GraphTrainer:
         it, so the default base key still yields per-step masks."""
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        if self._step_fn is None:  # sharded mode: shardings need the state
+            self._step_fn = self._build_step(self._donate, state)
         return self._step_fn(state, features, labels, rng)
 
     # -- fit ----------------------------------------------------------------

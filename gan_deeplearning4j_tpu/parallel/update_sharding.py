@@ -1,0 +1,567 @@
+"""Cross-replica weight-update sharding (ROADMAP mesh-scale compute half).
+
+Implements "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (PAPERS.md) for the data-parallel trainer: instead
+of every mesh shard redundantly applying the identical full optimizer
+update after the gradient all-reduce, the flat param/updater key space is
+partitioned across the ``data`` axis, each shard applies the update only
+for the keys it owns, and the updated params are all-gathered back to
+replicated. Gradients then only need to be *reduce-scattered* (each shard
+needs the summed gradient for its keys alone), and the resident updater
+state drops to ~1/N per device — PROFILE.md puts trainable state at
+13-20% of HBM traffic, so this is both an HBM and a step-time lever.
+
+Two design anchors:
+
+- **The partition is the checkpoint partition.** Ownership of a key is
+  :func:`gan_deeplearning4j_tpu.utils.serializer.shard_assignment`
+  evaluated on the sorted global flat key namespace — the deterministic
+  size-balanced partition the mesh checkpoint plane's
+  ``serializer.shard_keys`` writes shard files with (both sides derive it
+  from the same flat state, so N processes agree without communicating).
+  A compute shard therefore owns the same updater keys as the checkpoint
+  shard of the same index: shard files map 1:1 onto compute shards with
+  **no format change** (restore merges shards regardless of membership,
+  so pre-existing round-robin generations keep restoring), and elastic
+  reshard-on-restore stays a pure re-grouping in both directions.
+- **The sharding is expressed, not hand-rolled.** Owned keys are packed
+  into one ``(num_shards, width)`` row matrix per updater-spec group,
+  placed with ``NamedSharding(mesh, P(data))`` so row *k* lives on shard
+  *k*. The update math runs on the rows under that constraint; XLA's SPMD
+  partitioner then materializes the comms — the replicated->rows
+  transition after the gradient reduction is each shard slicing its own
+  row (the reduce-scatter seam the paper's XLA pass targets), and the
+  rows->replicated transition on the new params is the all-gather. This is
+  the annotation-driven formulation of the paper, which is itself an XLA
+  pass, not a hand-written collective schedule.
+
+Exactness contract (docs/RESILIENCE.md, update-sharding section):
+packing is reshape/slice/concat/pad and the in-tree updaters are
+elementwise, so with ``exact_grads`` (default) pinning the backward
+replicated, GRADS AND UPDATER STATE are proven digest-exact against the
+replicated :class:`~gan_deeplearning4j_tpu.optim.optimizer.GraphOptimizer`
+path at mesh 1/2/4 on forced host devices. Params track within a few
+ulps per step: XLA selects divide/rsqrt and fma forms for the delta per
+program shape, a codegen variance no annotation controls — and GAN
+dynamics amplify any ulp chaotically across iterations, so cross-MODE
+experiment parity is tolerance-based (tested at one fused iteration).
+Within-mode determinism and the supervisor's bit-exact RESUME contract
+are untouched (resume compares a program against itself). Checkpoint
+pack/unpack round-trips are bit-exact in both directions at any
+mesh-size pair. ``exact_grads=False`` additionally lets GSPMD shard the
+backward itself (partial-grad sub-contractions + reduce-scatter — the
+paper's full comms win) at the price of reassociated grad reductions —
+the mode to measure on chip.
+
+Multi-field updater state (Adam's m/v/t) is owned as a unit by the owner
+of the param's FIRST state key in sorted order; scalar fields (Adam's t)
+are stored broadcast per element so every update stays elementwise.
+Single-field updaters (RmsProp — the reference's only optimizer) and
+stateless ones map 1:1 onto the checkpoint key partition exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from gan_deeplearning4j_tpu.optim.optimizer import GraphOptimizer
+
+
+@dataclasses.dataclass(frozen=True)
+class _Slot:
+    """One piece of a trainable param leaf in the packed row layout.
+
+    Small leaves are one whole-leaf piece owned by the checkpoint
+    partition's shard (``start``/``stop`` span the leaf). Leaves bigger
+    than the group's split threshold are element-split into one piece per
+    shard — a single 6.4M-element dense kernel is 59% of the reference
+    model's updater bytes, so whole-leaf ownership alone could never
+    approach the 1/N residency target."""
+
+    key: str                 # flat param key: <model>/params/<layer>/<pname>
+    layer: str
+    pname: str
+    shape: Tuple[int, ...]
+    start: int               # element range [start, stop) of the flat leaf
+    stop: int
+    row: int                 # owning shard index
+    offset: int              # start position within (row, group)
+    split: bool              # True when the leaf is element-split
+    state_keys: Tuple[str, ...]  # flat updater keys, sorted (may be empty)
+
+    @property
+    def size(self) -> int:
+        return self.stop - self.start
+
+
+@dataclasses.dataclass
+class _Group:
+    """All slots sharing one (updater spec, param dtype): one packed row
+    matrix for params/grads and one per state field."""
+
+    spec: Any                # the UpdaterSpec (frozen dataclass, hashable)
+    dtype: Any               # param/grad dtype of every slot in the group
+    fields: Tuple[str, ...]  # state field names, sorted ("cache"; "m","v","t")
+    field_dtypes: Dict[str, Any]
+    scalar_fields: frozenset  # fields whose tree form is 0-d (Adam's t)
+    slots: List[_Slot] = dataclasses.field(default_factory=list)
+    rows: List[List[_Slot]] = dataclasses.field(default_factory=list)
+    width: int = 0
+
+
+def flat_model_keys(model_name: str, params: Dict,
+                    optimizer: GraphOptimizer) -> Dict[str, int]:
+    """The flat checkpoint key namespace ONE model contributes to
+    ``GanExperiment._flat_state()`` as a key -> element-count mapping
+    (the partition input): every param leaf, every updater state leaf,
+    and the step counter — derived from shapes alone (eval_shape), no
+    state materialized."""
+    from gan_deeplearning4j_tpu.utils.serializer import (
+        _element_count,
+        _flatten,
+    )
+
+    keys: Dict[str, Any] = {}
+    _flatten(f"{model_name}/params", params, keys)
+    _flatten(f"{model_name}/updater", optimizer.state_structs(params), keys)
+    keys[f"{model_name}/step"] = None
+    return {k: _element_count(v) for k, v in keys.items()}
+
+
+class UpdateShardingPlan:
+    """The deterministic partition + packed layout for one model's
+    trainable state over the mesh ``data_axis``.
+
+    ``global_keys`` maps every flat key the partition is taken over to
+    its element count (the experiment passes its ``_flat_state()``
+    namespace so ownership matches the mesh checkpoint shards — both
+    sides evaluate :func:`serializer.shard_assignment` on the same
+    input); ``None`` derives it from this model alone — the
+    standalone-trainer degenerate case, identical to a single-model
+    experiment's namespace.
+    """
+
+    def __init__(self, graph, optimizer: GraphOptimizer, params: Dict,
+                 mesh, data_axis: str = "data", model_name: str = "model",
+                 global_keys: Optional[Dict[str, int]] = None,
+                 exact_grads: bool = True):
+        del graph  # the optimizer carries everything layout needs
+        from gan_deeplearning4j_tpu.utils.serializer import shard_assignment
+
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_name = model_name
+        self.num_shards = int(mesh.shape[data_axis])
+        self.base = optimizer
+        # exact_grads=True pins the gradient tree REPLICATED before the
+        # rows are sliced out: the backward then compiles exactly like
+        # the replicated baseline's (full grads on every shard — the
+        # all-reduce it already pays), and since everything downstream is
+        # elementwise on the same bytes, sharded updates are bit-exact
+        # against the baseline. False lets GSPMD propagate the row
+        # sharding INTO the backward (partial-grad sub-contractions +
+        # reduce-scatter — the paper's full comms win), at the price of
+        # reassociated reductions: ~1 ulp per step, which GAN dynamics
+        # amplify — the documented-tolerance mode for chip measurement.
+        self.exact_grads = exact_grads
+        if global_keys is None:
+            global_keys = flat_model_keys(model_name, params, optimizer)
+        assign = shard_assignment(dict(global_keys), self.num_shards)
+
+        structs = optimizer.state_structs(params)
+        self._groups: Dict[str, _Group] = {}
+        self._slots: List[_Slot] = []
+        for layer in sorted(params):
+            spec = optimizer.updaters.get(layer)
+            if spec is None:
+                continue
+            for pname in sorted(params[layer]):
+                if not optimizer.trainable(layer, pname):
+                    continue
+                leaf = params[layer][pname]
+                shape = tuple(jnp.shape(leaf))
+                dtype = jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") \
+                    else leaf.dtype
+                field_structs = structs.get(layer, {}).get(pname, {})
+                fields = tuple(sorted(field_structs))
+                state_keys = tuple(
+                    f"{model_name}/updater/{layer}/{pname}/{f}" for f in fields
+                )
+                anchor = state_keys[0] if state_keys \
+                    else f"{model_name}/params/{layer}/{pname}"
+                if anchor not in assign:
+                    raise ValueError(
+                        f"update-sharding anchor key {anchor!r} is missing "
+                        f"from the global flat key list — the partition "
+                        f"would disagree with the checkpoint plane")
+                slot = _Slot(
+                    key=f"{model_name}/params/{layer}/{pname}",
+                    layer=layer, pname=pname, shape=shape,
+                    start=0, stop=max(1, int(jnp.size(leaf))),
+                    row=assign[anchor],
+                    offset=-1,  # assigned per group below
+                    split=False,
+                    state_keys=state_keys,
+                )
+                gid = f"{spec.kind}|{repr(spec)}|{jnp.dtype(dtype).name}"
+                group = self._groups.get(gid)
+                if group is None:
+                    group = _Group(
+                        spec=spec, dtype=jnp.dtype(dtype), fields=fields,
+                        field_dtypes={
+                            f: jnp.dtype(field_structs[f].dtype)
+                            for f in fields
+                        },
+                        scalar_fields=frozenset(
+                            f for f in fields
+                            if len(field_structs[f].shape) == 0
+                        ),
+                    )
+                    self._groups[gid] = group
+                group.slots.append(slot)
+
+        # Element-split oversized leaves: a leaf above the group's split
+        # threshold becomes one contiguous piece per shard. Whole-leaf
+        # ownership keeps the 1:1 checkpoint mapping for everything
+        # below the threshold; splitting is what bounds the widest row
+        # (per-device residency) at ~group_total/N + threshold.
+        n = self.num_shards
+        for group in self._groups.values():
+            total = sum(s.size for s in group.slots)
+            threshold = max(1024, -(-total // (4 * n)))
+            pieces: List[_Slot] = []
+            for slot in group.slots:
+                if n > 1 and slot.size > threshold:
+                    chunk = -(-slot.size // n)  # ceil
+                    for j in range(n):
+                        lo, hi = j * chunk, min((j + 1) * chunk, slot.size)
+                        if lo >= hi:
+                            continue
+                        pieces.append(dataclasses.replace(
+                            slot, start=lo, stop=hi, row=j, split=True))
+                else:
+                    pieces.append(slot)
+            group.slots = pieces
+
+        # row layout: per group, the pieces owned by each shard in sorted
+        # (key, start) order, offsets cumulative, rows padded to the
+        # widest shard
+        for group in self._groups.values():
+            rows: List[List[_Slot]] = [[] for _ in range(self.num_shards)]
+            for slot in sorted(group.slots, key=lambda s: (s.key, s.start)):
+                row = rows[slot.row]
+                offset = sum(s.size for s in row)
+                row.append(dataclasses.replace(slot, offset=offset))
+            group.rows = rows
+            group.slots = [s for row in rows for s in row]
+            group.width = max(
+                1, max(sum(s.size for s in row) for row in rows))
+            self._slots.extend(group.slots)
+        self._gids = sorted(self._groups)
+
+    # -- shardings ---------------------------------------------------------
+    def rows_sharding(self) -> NamedSharding:
+        """Row *k* of every packed matrix lives on shard *k* of the data
+        axis — the placement JG013/JG018 police (the axis name is the
+        plan's, never a copy-pasted literal)."""
+        return NamedSharding(self.mesh, PartitionSpec(self.data_axis))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def state_shardings(self) -> "PackedOptState":
+        rows = self.rows_sharding()
+        return PackedOptState(
+            {gid: {f: rows for f in self._groups[gid].fields}
+             for gid in self._gids},
+            self,
+        )
+
+    # -- partition introspection ------------------------------------------
+    def updater_keys_for_shard(self, shard: int) -> List[str]:
+        """Flat updater state keys WHOLLY resident on compute shard
+        ``shard`` — the set the 1:1 checkpoint-mapping tests compare
+        against ``serializer.shard_keys`` (element-split keys span every
+        shard and are listed by :meth:`element_split_state_keys`)."""
+        out = []
+        for gid in self._gids:
+            for slot in self._groups[gid].rows[shard]:
+                if not slot.split:
+                    out.extend(slot.state_keys)
+        return sorted(out)
+
+    def element_split_state_keys(self) -> List[str]:
+        """Updater keys whose leaves are element-split across every shard
+        (each shard holds one contiguous slice) — the leaves too big for
+        whole-leaf balance; their checkpoint bytes are written merged by
+        whichever worker the key partition assigns them to."""
+        return sorted({k for s in self._slots if s.split
+                       for k in s.state_keys})
+
+    def describe(self) -> Dict:
+        """Layout summary for bench records: shard counts, per-group
+        widths, split keys, and the padding overhead of the row layout."""
+        groups = {}
+        for gid in self._gids:
+            g = self._groups[gid]
+            used = [sum(s.size for s in row) for row in g.rows]
+            groups[gid] = {
+                "kind": g.spec.kind,
+                "fields": list(g.fields),
+                "width": g.width,
+                "rows_used": used,
+                "split_keys": sorted({s.key for s in g.slots if s.split}),
+                "padding_fraction": (
+                    1.0 - (sum(used) / float(g.width * self.num_shards))
+                ),
+            }
+        return {
+            "model": self.model_name,
+            "num_shards": self.num_shards,
+            "data_axis": self.data_axis,
+            "exact_grads": self.exact_grads,
+            "groups": groups,
+        }
+
+    def _pieces_by_key(self, group: _Group) -> Dict[str, List[_Slot]]:
+        by_key: Dict[str, List[_Slot]] = {}
+        for slot in group.slots:
+            by_key.setdefault(slot.key, []).append(slot)
+        return {k: sorted(v, key=lambda s: s.start)
+                for k, v in by_key.items()}
+
+    # -- packing -----------------------------------------------------------
+    def _pack_rows(self, group: _Group, leaf_of: Callable[[_Slot], Any],
+                   dtype) -> jnp.ndarray:
+        """(num_shards, width) row matrix: row k = the flattened leaf
+        pieces shard k owns, in sorted (key, start) order, zero-padded to
+        the group width. ``leaf_of`` returns the FULL leaf (or a scalar);
+        piece slicing happens here. Pure reshape/slice/concat/pad —
+        exact, and cheap enough for XLA to fuse away."""
+        rows = []
+        for row_slots in group.rows:
+            parts = []
+            for slot in row_slots:
+                leaf = jnp.asarray(leaf_of(slot), dtype)
+                if leaf.ndim == 0:
+                    # scalar state (Adam's t): stored broadcast per element
+                    # so the update stays elementwise
+                    parts.append(jnp.broadcast_to(leaf, (slot.size,)))
+                else:
+                    parts.append(leaf.reshape(-1)[slot.start:slot.stop])
+            used = sum(s.size for s in row_slots)
+            if parts:
+                row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                if used < group.width:
+                    row = jnp.pad(row, (0, group.width - used))
+            else:
+                row = jnp.zeros((group.width,), dtype)
+            rows.append(row)
+        return jnp.stack(rows)
+
+    def init_packed(self, params: Dict) -> "PackedOptState":
+        """Fresh packed state straight from the optim layer's shard-slice
+        init (:meth:`UpdaterSpec.init_state_packed`) — bit-identical
+        values to packing the replicated tree init, without ever
+        materializing the full replicated state tree."""
+        per_key: Dict[str, Dict[str, Any]] = {}
+        for gid in self._gids:
+            group = self._groups[gid]
+            for slot in group.slots:
+                if slot.key not in per_key:
+                    flat = jnp.asarray(
+                        params[slot.layer][slot.pname],
+                        group.dtype).reshape(-1)
+                    per_key[slot.key] = group.spec.init_state_packed(flat)
+        groups = {}
+        for gid in self._gids:
+            group = self._groups[gid]
+            groups[gid] = {
+                field: self._pack_rows(
+                    group,
+                    lambda s, f=field: per_key[s.key][f],
+                    group.field_dtypes[field],
+                )
+                for field in group.fields
+            }
+        return PackedOptState(groups, self)
+
+    def pack_state(self, opt_state: Dict) -> "PackedOptState":
+        """Tree-form updater state -> packed rows. The inverse of
+        :meth:`unpack_state` up to zero padding; packing a tree and
+        unpacking it back is bit-exact (elastic-restore property)."""
+        groups = {}
+        for gid in self._gids:
+            group = self._groups[gid]
+            groups[gid] = {
+                field: self._pack_rows(
+                    group,
+                    lambda s, f=field: opt_state[s.layer][s.pname][f],
+                    group.field_dtypes[field],
+                )
+                for field in group.fields
+            }
+        return PackedOptState(groups, self)
+
+    def unpack_state(self, packed: "PackedOptState") -> Dict:
+        """Packed rows -> the tree form GraphOptimizer.init produces —
+        what checkpoints serialize (no format change) and digests are
+        taken over."""
+        state: Dict = {}
+        for gid in self._gids:
+            group = self._groups[gid]
+            for pieces in self._pieces_by_key(group).values():
+                first = pieces[0]
+                entry = {}
+                for field in group.fields:
+                    rows = packed.groups[gid][field]
+                    if field in group.scalar_fields:
+                        entry[field] = rows[first.row, first.offset]
+                    else:
+                        segs = [rows[p.row, p.offset:p.offset + p.size]
+                                for p in pieces]
+                        flat = segs[0] if len(segs) == 1 \
+                            else jnp.concatenate(segs)
+                        entry[field] = flat.reshape(first.shape)
+                state.setdefault(first.layer, {})[first.pname] = entry
+        # stateless updaters still own an (empty) entry in the tree form
+        for slot in self._slots:
+            state.setdefault(slot.layer, {}).setdefault(slot.pname, {})
+        return state
+
+    # -- the sharded update -----------------------------------------------
+    def apply_update(self, params: Dict, grads: Dict,
+                     packed: "PackedOptState",
+                     lr_scale=None) -> Tuple[Dict, "PackedOptState"]:
+        """The sharded replacement for ``GraphOptimizer.step``: clip (same
+        math, replicated), reduce-scatter the gradients into owned rows,
+        update locally with the sharded state, all-gather the params.
+
+        Must run inside jit on the plan's mesh (the sharding constraints
+        are the whole point). Per-element math is GraphOptimizer.step's
+        exactly — every in-tree updater is elementwise."""
+        base = self.base
+        grads = base.clip_grads(grads)
+        rows_spec = self.rows_sharding()
+        constrain = jax.lax.with_sharding_constraint
+        if self.exact_grads:
+            rep = self.replicated_sharding()
+            grads = jax.tree_util.tree_map(
+                lambda g: constrain(g, rep), grads)
+        new_params = {layer: dict(v) for layer, v in params.items()}
+        new_groups: Dict[str, Dict[str, Any]] = {}
+        upd_by_gid: Dict[str, Any] = {}
+        for gid in self._gids:
+            group = self._groups[gid]
+            # the replicated->rows transition on summed grads is each
+            # shard slicing its own row: the reduce-scatter seam
+            g_rows = constrain(
+                self._pack_rows(
+                    group, lambda s: grads[s.layer][s.pname], group.dtype),
+                rows_spec)
+            p_rows = constrain(
+                self._pack_rows(
+                    group, lambda s: params[s.layer][s.pname], group.dtype),
+                rows_spec)
+            state = {f: constrain(packed.groups[gid][f], rows_spec)
+                     for f in group.fields}
+            delta, new_state = group.spec.apply(state, g_rows, p_rows)
+            if lr_scale is not None:
+                # cast like GraphOptimizer.step: an f32 scale on a bf16
+                # delta would silently promote params out of bf16 storage
+                delta = delta * jnp.asarray(lr_scale, delta.dtype)
+            upd_by_gid[gid] = p_rows - delta
+            new_groups[gid] = {
+                f: constrain(new_state[f], rows_spec) for f in group.fields
+            }
+
+        # THE param all-gather — exactly ONE collective per dtype per
+        # optimizer step: the groups' updated row matrices are
+        # concatenated along the width axis before the replicate
+        # constraint, and every leaf slice afterwards is device-local.
+        # Lesser shapes measured slower on the CPU container (collectives
+        # are sync points across device threads sharing two cores): one
+        # gather per LEAF ~1.4x step time, one per GROUP still ~1.2x.
+        by_dtype: Dict[Any, List[str]] = {}
+        for gid in self._gids:
+            by_dtype.setdefault(self._groups[gid].dtype, []).append(gid)
+        for dtype, gids in by_dtype.items():
+            cat = upd_by_gid[gids[0]] if len(gids) == 1 \
+                else jnp.concatenate([upd_by_gid[g] for g in gids], axis=1)
+            cat = constrain(cat, self.replicated_sharding())
+            col = 0
+            for gid in gids:
+                group = self._groups[gid]
+                upd_full = cat[:, col:col + group.width]
+                col += group.width
+                for pieces in self._pieces_by_key(group).values():
+                    first = pieces[0]
+                    segs = [upd_full[p.row, p.offset:p.offset + p.size]
+                            for p in pieces]
+                    flat = segs[0] if len(segs) == 1 \
+                        else jnp.concatenate(segs)
+                    new_params[first.layer][first.pname] = flat.reshape(
+                        first.shape)
+        # non-trainable leaves (BN running stats) were already replicated
+        # and pass through from the forward's new_params untouched; the
+        # jit out_shardings pin the whole tree replicated
+        return new_params, PackedOptState(new_groups, self)
+
+
+@jax.tree_util.register_pytree_node_class
+class PackedOptState:
+    """The packed sharded updater state: ``{group id: {field: (N, width)
+    rows}}`` with the plan as static aux data (identity-hashed, so jit
+    caches per plan — one plan per trainer by construction)."""
+
+    def __init__(self, groups: Dict[str, Dict[str, Any]],
+                 plan: UpdateShardingPlan):
+        self.groups = groups
+        self.plan = plan
+
+    def tree_flatten(self):
+        return (self.groups,), self.plan
+
+    @classmethod
+    def tree_unflatten(cls, plan, children):
+        return cls(children[0], plan)
+
+    def __repr__(self) -> str:
+        return (f"PackedOptState(model={self.plan.model_name!r}, "
+                f"shards={self.plan.num_shards}, "
+                f"groups={sorted(self.groups)})")
+
+
+class ShardedGraphOptimizer:
+    """Drop-in for :class:`GraphOptimizer` whose state is the packed
+    sharded layout. ``init``/``step`` keep the base signatures so the
+    fused iteration body and the scan device loop run unchanged; ``base``
+    is the wrapped replicated optimizer (serialization and elastic
+    restore re-init through it — tree form is the checkpoint contract)."""
+
+    def __init__(self, plan: UpdateShardingPlan):
+        self.plan = plan
+        self.base = plan.base
+
+    def trainable(self, layer: str, pname: str) -> bool:
+        return self.base.trainable(layer, pname)
+
+    @property
+    def updaters(self):
+        return self.base.updaters
+
+    def init(self, params: Dict) -> PackedOptState:
+        """Packed state with the SAME values the replicated init produces
+        (shard-slice init per slot, then pack), so fresh sharded and
+        replicated runs start from identical bytes."""
+        return self.plan.init_packed(params)
+
+    def step(self, params: Dict, grads: Dict, opt_state: PackedOptState,
+             lr_scale=None) -> Tuple[Dict, PackedOptState]:
+        return self.plan.apply_update(params, grads, opt_state,
+                                      lr_scale=lr_scale)

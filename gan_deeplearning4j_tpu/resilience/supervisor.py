@@ -152,6 +152,10 @@ class TrainingSupervisor:
         self._preempt = False
         self.retry_delays: List[float] = []
         self.events: List[dict] = []
+        # mesh mode: the updater shard this worker last wrote (index,
+        # count, files) — surfaced in the summary so drill invariant
+        # messages can name the owning worker on a shard mismatch
+        self._last_shard: Optional[dict] = None
         # telemetry registry series (docs/OBSERVABILITY.md); the events
         # list above remains the drill's per-run record
         registry = get_registry()
@@ -193,7 +197,14 @@ class TrainingSupervisor:
     def state_digests(exp) -> dict:
         """Canonical content digests of every trained state — reproducible
         across processes (unlike zip bytes), the currency of the drill's
-        bit-exactness check."""
+        bit-exactness check. Experiments expose ``digest_states()`` for
+        the canonical tree-form view (under update sharding the packed
+        updater rows are unpacked first, so replicated and sharded runs
+        digest identically when the math agrees); fakes without it are
+        digested as-is."""
+        if hasattr(exp, "digest_states"):
+            return {name: tree_digest(state)
+                    for name, state in exp.digest_states().items()}
         out = {
             "dis": tree_digest(exp.dis_state),
             "gan": tree_digest(exp.gan_state),
@@ -208,15 +219,19 @@ class TrainingSupervisor:
         t0 = time.perf_counter()
         digests = self.state_digests(exp)
         extra = {"kind": "training", "state_digests": digests}
+        shard_files: List[str] = []
         if self.mesh is not None:
             # coordinated mesh publish: THIS worker stages only its shard;
             # worker 0's two-phase commit makes the generation visible for
             # everyone (every worker blocks until publication or timeout)
+            def shard_writer(d: str) -> List[str]:
+                files = exp.save_model_shard(
+                    d, self.mesh.worker, self.mesh.world_size)
+                shard_files.extend(files)
+                return files
+
             generation = self.mesh.publish(
-                self.store,
-                lambda d: exp.save_model_shard(
-                    d, self.mesh.worker, self.mesh.world_size),
-                step=exp.batch_counter,
+                self.store, shard_writer, step=exp.batch_counter,
                 extra=extra,
             )
         else:
@@ -226,10 +241,26 @@ class TrainingSupervisor:
                 extra=extra,
             )
         seconds = time.perf_counter() - t0
-        self.events.append({
+        event = {
             "event": "publish", "generation": generation.number,
             "step": exp.batch_counter, "seconds": seconds,
-        })
+        }
+        if self.mesh is not None:
+            # surface which updater shard this worker wrote — until now
+            # only the file names encoded it, so a drill shard mismatch
+            # could not name the owning worker
+            event.update({
+                "shard_index": self.mesh.worker,
+                "shard_count": self.mesh.world_size,
+                "shard_files": sorted(shard_files),
+            })
+            self._last_shard = {
+                "worker": self.mesh.worker,
+                "shard_index": self.mesh.worker,
+                "shard_count": self.mesh.world_size,
+                "files": sorted(shard_files),
+            }
+        self.events.append(event)
         if self.faults is not None and (self.mesh is None
                                         or self.mesh.is_coordinator):
             # post-publish faults (corrupt) mutate the published bytes —
@@ -438,5 +469,6 @@ class TrainingSupervisor:
             "state_digests": (final_publish or {}).get("digests"),
             "serve_publish_count": (serve or {}).get("count", 0),
             "final_serve_generation": (serve or {}).get("generation"),
+            "updater_shard": self._last_shard,
             "events": list(self.events),
         }

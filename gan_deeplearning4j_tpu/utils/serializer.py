@@ -21,6 +21,7 @@ export has (SURVEY §3.3 hot loop 3) is avoided at every host boundary here.
 from __future__ import annotations
 
 import hashlib
+import heapq
 import io
 import json
 import os
@@ -88,18 +89,77 @@ def _npz_decode(npz_bytes: bytes, ext_dtypes: Dict[str, str]) -> Dict[str, np.nd
     return flat
 
 
+def _element_count(value) -> int:
+    """Leaf size (elements) for the balanced partition: arrays/structs by
+    shape, ints verbatim, anything else (step scalars, None placeholders)
+    counts 1."""
+    if value is None:
+        return 1
+    if isinstance(value, (int, np.integer)):
+        return max(1, int(value))
+    shape = getattr(value, "shape", None)
+    if shape is None:
+        return 1
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return max(1, n)
+
+
+def shard_assignment(sizes: Dict[str, int], shard_count: int) -> Dict[str, int]:
+    """The deterministic SIZE-BALANCED partition of the flat key space:
+    key -> owning shard. Within each kind bucket (the second path
+    component — ``params`` / ``updater`` / ``step``), keys go largest-
+    first to the least-loaded shard (ties: lowest index). Derived from
+    sorting and sizes alone, so N processes — checkpoint writers AND the
+    update-sharding compute plan — agree without communicating, the same
+    property the original round-robin had. Per-bucket balancing is what
+    gives the compute half its memory win: resident updater bytes per
+    shard stay ≈ total/N, where strict round-robin over sorted keys
+    systematically parks every big conv ``W`` cache on one shard (the
+    W/b key alternation keeps equal parities together)."""
+    if shard_count < 1:
+        raise ValueError("shard_count must be >= 1")
+
+    def bucket(key: str) -> str:
+        parts = key.split("/")
+        return parts[1] if len(parts) > 1 else ""
+
+    assign: Dict[str, int] = {}
+    for b in sorted({bucket(k) for k in sizes}):
+        order = sorted((k for k in sizes if bucket(k) == b),
+                       key=lambda k: (-_element_count(sizes[k]), k))
+        heap = [(0, i) for i in range(shard_count)]
+        heapq.heapify(heap)
+        for key in order:
+            load, i = heapq.heappop(heap)
+            assign[key] = i
+            heapq.heappush(heap, (load + _element_count(sizes[key]), i))
+    return assign
+
+
 def shard_keys(keys, shard_index: int, shard_count: int):
-    """The deterministic key partition of the mesh checkpoint plane:
-    shard ``shard_index`` of ``shard_count`` owns every ``shard_count``-th
-    key of the SORTED key list. Round-robin over the sorted order balances
-    leaf counts, is stable across processes (sorting is the only input),
-    and the union over all shards is exactly the full key set — the
-    property elastic restore merges on."""
+    """The deterministic key partition of the mesh checkpoint plane.
+
+    Given a bare key list, shard ``shard_index`` of ``shard_count`` owns
+    every ``shard_count``-th key of the SORTED key list (PR 9's original
+    round-robin — count-balanced, stable across processes). Given a
+    MAPPING (flat key -> array/struct/size), ownership is the
+    size-balanced :func:`shard_assignment` instead, which the
+    update-sharding compute plan shares — compute shard k then holds
+    exactly the updater keys checkpoint shard k writes, at ≈ 1/N of the
+    bytes. Either way the union over all shards is exactly the full key
+    set — the property elastic restore merges on (restore never depends
+    on WHICH shard held a key, so generations written under either rule
+    keep restoring)."""
     if shard_count < 1:
         raise ValueError("shard_count must be >= 1")
     if not 0 <= shard_index < shard_count:
         raise ValueError(f"shard_index {shard_index} outside "
                          f"[0, {shard_count})")
+    if isinstance(keys, dict):
+        assign = shard_assignment(keys, shard_count)
+        return sorted(k for k, s in assign.items() if s == shard_index)
     return sorted(keys)[shard_index::shard_count]
 
 
@@ -299,5 +359,9 @@ class ModelSerializer:
 
         _, params, opt_state, step = read_model(path)
         if opt_state is None:
-            opt_state = trainer.optimizer.init(params)
+            # always the TREE-form init: checkpoints serialize the tree
+            # contract regardless of the trainer's compute layout (an
+            # update-sharding trainer exposes its replicated base)
+            opt = getattr(trainer.optimizer, "base", trainer.optimizer)
+            opt_state = opt.init(params)
         return TrainState(params, opt_state, jnp.asarray(step, jnp.int32))

@@ -224,6 +224,29 @@ def _gang_digests(gang: list) -> list:
             if rc == 0 and s is not None]
 
 
+def _gang_shards(gang: list) -> list:
+    """Which updater shard each gang worker wrote (supervisor summary's
+    ``updater_shard``: index, count, files) — so a shard mismatch names
+    the owning worker instead of leaving it encoded in file names."""
+    out = []
+    for k, (rc, s, _) in enumerate(gang):
+        shard = dict((s or {}).get("updater_shard") or {})
+        # the summary's self-claimed rank must not mask which GANG SLOT
+        # produced the record — a rank/slot disagreement is exactly what
+        # a shard-mismatch log exists to expose
+        shard.pop("worker", None)
+        out.append({"worker": k, "rc": rc, **shard})
+    return out
+
+
+def _log_shard_owners(shards: list, what: str) -> None:
+    for rec in shards:
+        files = ", ".join(rec.get("files", [])) or "<none>"
+        log(f"{what}: worker {rec['worker']} wrote updater shard "
+            f"{rec.get('shard_index', '?')}/{rec.get('shard_count', '?')} "
+            f"({files})")
+
+
 def run_multihost_drill(args, workdir: str, total: int,
                         publish_every: int) -> dict:
     """The mesh-plane drill (see module docstring). Returns the BENCH
@@ -310,11 +333,17 @@ def run_multihost_drill(args, workdir: str, total: int,
                      workdir, mesh_timeout_s=args.mesh_timeout)
     recovery_wall = time.perf_counter() - t_recover
     digests2 = _gang_digests(gang2)
+    shards2 = _gang_shards(gang2)
     invariants["mh_recovered"] = len(digests2) == n
     invariants["mh_workers_agree"] = (
         len(digests2) == n and all(d == digests2[0] for d in digests2))
     invariants["mh_bit_exact_resume"] = (
         bool(digests2) and digests2[0] == oracle.get("state_digests"))
+    if not (invariants["mh_workers_agree"]
+            and invariants["mh_bit_exact_resume"]):
+        # name the owning worker per shard so a mismatch is attributable
+        # without decoding shard file names by hand
+        _log_shard_owners(shards2, "shard mismatch")
     coord_summary = gang2[0][1] or {}
     restores = [e for e in coord_summary.get("events", [])
                 if e.get("event") == "restore"]
@@ -327,6 +356,7 @@ def run_multihost_drill(args, workdir: str, total: int,
         "kill_step": kill_step,
         "victim": victim,
         "gang1_rcs": rcs,
+        "worker_shards": shards2,
         "recovery_wall_s": recovery_wall,
         "restore_s": coord_summary.get("restore_s"),
         "time_to_first_step_s": coord_summary.get("time_to_first_step_s"),

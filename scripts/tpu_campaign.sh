@@ -73,6 +73,17 @@ if ! JAX_PLATFORMS=cpu timeout 900 python scripts/resilience_drill.py --smoke \
   echo "$(date +%H:%M:%S) multihost resilience drill smoke failed — campaign aborted (see resilience_mh_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Update-sharding parity smoke (CPU, forced host devices): the campaign's
+# long mesh runs may train with sharded optimizer updates — refuse to
+# start if sharded-vs-replicated parity (documented tolerance), the
+# ~1/N per-device resident-updater-bytes invariant, or the compute↔
+# checkpoint shard mapping regressed (enforced by the bench's own exit
+# code). Pinned to CPU so it never touches the chip.
+if ! JAX_PLATFORMS=cpu timeout 900 python scripts/update_sharding_bench.py --smoke \
+    --output artifacts/update_sharding_smoke.json > update_sharding_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) update-sharding parity smoke failed — campaign aborted (see update_sharding_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 # Reload smoke (CPU, subprocess train→serve loop): the campaign's artifacts
 # feed a fleet that updates weights while serving — refuse to start if the
 # zero-downtime swap, the canary quarantine, or the supervisor's serve-

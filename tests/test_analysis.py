@@ -1953,6 +1953,179 @@ class TestShardedStateSpecMismatch:
 
 
 # ===========================================================================
+# JG019 — prefetch/data-pipeline callback reached from a timed region
+# ===========================================================================
+
+class TestPrefetchCallbackInTimedRegion:
+    def test_true_positive_transform_in_timed_loop(self):
+        # the streaming-pipeline hazard JG009 is structurally blind to:
+        # the loop never CALLS the callback — the pipeline's refill does,
+        # inside the timed region
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def log_row(batch):\n"
+            "    jax.debug.print('batch {}', batch)\n"
+            "    return batch\n"
+            "def make_prefetch(inner, transform=None):\n"
+            "    return inner\n"
+            "def bench(inner, step):\n"
+            "    it = make_prefetch(inner, transform=log_row)\n"
+            "    t0 = time.perf_counter()\n"
+            "    while it.has_next():\n"
+            "        step(it.next())\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert "JG019" in codes(r)
+        assert "prefetch refill" in r.active[0].message
+
+    def test_true_positive_transitive_taint_and_passed_as_arg(self):
+        # the callback reaches jax.debug.* through a helper (project-index
+        # taint closure) and the pipeline is handed WHOLE to the timed
+        # consumer (`run(exp, it)`) instead of method-called
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def helper(x):\n"
+            "    jax.debug.print('x {}', x)\n"
+            "    return x\n"
+            "def transform(batch):\n"
+            "    return helper(batch)\n"
+            "def make_pipeline(inner, transform=None):\n"
+            "    return inner\n"
+            "def bench(run_fn, exp, inner):\n"
+            "    it = make_pipeline(inner, transform=transform)\n"
+            "    t0 = time.perf_counter()\n"
+            "    run_fn(exp, it)\n"
+            "    t1 = time.perf_counter()\n"
+            "    return t1 - t0\n"
+        )
+        assert codes(r) == ["JG019"]
+
+    def test_true_positive_for_loop_consumption(self):
+        # the iterator protocol IS consumption: `for batch in it:` inside
+        # the timed region must fire like it.next() does
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def log_row(batch):\n"
+            "    jax.debug.print('b')\n"
+            "    return batch\n"
+            "def make_prefetch(inner, transform=None):\n"
+            "    return inner\n"
+            "def bench(inner, step):\n"
+            "    it = make_prefetch(inner, transform=log_row)\n"
+            "    t0 = time.perf_counter()\n"
+            "    for batch in it:\n"
+            "        step(batch)\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert "JG019" in codes(r)
+
+    def test_true_positive_lambda_callback(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def make_prefetch(inner, transform=None):\n"
+            "    return inner\n"
+            "def bench(inner, step):\n"
+            "    it = make_prefetch(\n"
+            "        inner, transform=lambda b: jax.debug.print('b') or b)\n"
+            "    t0 = time.perf_counter()\n"
+            "    while it.has_next():\n"
+            "        step(it.next())\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert "JG019" in codes(r)
+
+    def test_true_negative_pure_transform(self):
+        # numpy-only host-side transforms are the feature working as
+        # intended — no host callback, no finding
+        r = run(
+            "import time\n"
+            "import numpy as np\n"
+            "def normalize(batch):\n"
+            "    return batch\n"
+            "def make_prefetch(inner, transform=None):\n"
+            "    return inner\n"
+            "def bench(inner, step):\n"
+            "    it = make_prefetch(inner, transform=normalize)\n"
+            "    t0 = time.perf_counter()\n"
+            "    while it.has_next():\n"
+            "        step(it.next())\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_consumed_outside_timed_region(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def log_row(batch):\n"
+            "    jax.debug.print('b')\n"
+            "    return batch\n"
+            "def make_prefetch(inner, transform=None):\n"
+            "    return inner\n"
+            "def build(inner, consume):\n"
+            "    it = make_prefetch(inner, transform=log_row)\n"
+            "    while it.has_next():\n"
+            "        consume(it.next())\n"
+            "    return time.perf_counter()\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_no_callback_argument(self):
+        # the repo's own run() shape: a prefetch built from an iterator +
+        # sharding only — nothing function-valued, nothing to taint
+        r = run(
+            "import time\n"
+            "def make_prefetch(inner, depth=2, sharding=None):\n"
+            "    return inner\n"
+            "def bench(inner, step, sharding):\n"
+            "    it = make_prefetch(inner, depth=2, sharding=sharding)\n"
+            "    t0 = time.perf_counter()\n"
+            "    while it.has_next():\n"
+            "        step(it.next())\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert codes(r) == []
+
+    def test_direct_callback_is_jg009_not_jg019(self):
+        # the loop calling jax.debug.print itself is JG009's finding —
+        # JG019 owns only the pipeline-construction indirection
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def bench(step, xs):\n"
+            "    t0 = time.perf_counter()\n"
+            "    for x in xs:\n"
+            "        jax.debug.print('x {}', x)\n"
+            "        step(x)\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert codes(r) == ["JG009"]
+
+    def test_suppression_applies(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def log_row(batch):\n"
+            "    jax.debug.print('b')\n"
+            "    return batch\n"
+            "def make_prefetch(inner, transform=None):\n"
+            "    return inner\n"
+            "def bench(inner, step):\n"
+            "    it = make_prefetch(inner, transform=log_row)  # jaxlint: disable=JG019\n"
+            "    t0 = time.perf_counter()\n"
+            "    while it.has_next():\n"
+            "        step(it.next())\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert "JG019" not in codes(r)
+        assert "JG019" in [f.code for f in r.suppressed]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

@@ -131,6 +131,25 @@ def test_device_prefetch_matches_inner():
     assert pre.has_next()
 
 
+def test_device_prefetch_transform_hook():
+    # the host-side per-batch hook (jaxlint JG019's seam): applied before
+    # device placement, once per batch
+    from gan_deeplearning4j_tpu.data.dataset import DataSet
+
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    seen = []
+
+    def scale(batch):
+        seen.append(batch.num_examples())
+        return DataSet(batch.features * 2.0)
+
+    pre = DevicePrefetchIterator(
+        ArrayDataSetIterator(x, batch_size=6), depth=2, transform=scale)
+    got = np.concatenate([np.asarray(b.features) for b in pre])
+    np.testing.assert_array_equal(got, x * 2.0)
+    assert seen == [6, 6]
+
+
 def test_stratified_sample_and_prepare(tmp_path):
     (x, y), _ = synthetic_mnist(num_train=400, num_test=50)
     xs, ys = stratified_sample(x, y, per_class=5)
