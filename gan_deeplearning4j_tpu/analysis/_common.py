@@ -42,6 +42,20 @@ HOST_CALLBACKS = {
 #: jit-like transforms that accept donate_argnums
 JIT_WRAPPERS = {"jax.jit", "jax.pmap"}
 
+#: synchronous host I/O — file writes/fsyncs and blocking network calls.
+#: On the step path every one of these idles the accelerator while the
+#: host blocks (the measured checkpoint-write stall: 34% of wall on the
+#: toy workload, BENCH_resilience_r01.json); JG020 flags them when a
+#: timed train-step region reaches one through the call graph.
+SYNC_IO_CALLS = {
+    "open", "io.open", "os.fsync", "os.fdatasync", "os.write",
+    "os.replace", "os.rename",
+    "shutil.copy", "shutil.copy2", "shutil.copyfile", "shutil.copytree",
+    "urllib.request.urlopen",
+    "socket.socket", "socket.create_connection",
+    "http.client.HTTPConnection", "http.client.HTTPSConnection",
+}
+
 
 def build_import_map(tree: ast.AST) -> dict:
     """Local name -> fully-qualified dotted prefix, from import statements.

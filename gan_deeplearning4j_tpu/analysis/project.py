@@ -131,6 +131,7 @@ class FunctionSummary:
     prng_params: Tuple[str, ...]
     calls: Tuple[str, ...]           # resolved names this function calls
     has_host_callback: bool          # DIRECT io/pure_callback or jax.debug.*
+    has_sync_io: bool = False        # DIRECT open/fsync/urlopen/socket...
     node: ast.AST = dataclasses.field(repr=False, default=None)
 
     @property
@@ -169,6 +170,7 @@ class ProjectIndex:
         self.modules: Dict[str, ModuleInfo] = {}
         self.by_path: Dict[str, ModuleInfo] = {}
         self._taint_cache: Dict[str, bool] = {}
+        self._io_taint_cache: Dict[str, bool] = {}
         for mod in srcmods:
             self._index_module(mod)
         # second pass: module-level donators that need every summary in place
@@ -233,12 +235,15 @@ class ProjectIndex:
                     returns_donation = nums
         calls: List[str] = []
         has_cb = False
+        has_io = False
         for n in ast.walk(fn):
             if not isinstance(n, ast.Call):
                 continue
             resolved = mod.resolve(n.func)
             if resolved in _common.HOST_CALLBACKS:
                 has_cb = True
+            if resolved in _common.SYNC_IO_CALLS:
+                has_io = True
             if resolved is None:
                 continue
             calls.append(self._canonical_call(info, resolved))
@@ -256,6 +261,7 @@ class ProjectIndex:
             prng_params=tuple(p for p in params if looks_like_prng_param(p)),
             calls=tuple(dict.fromkeys(calls)),
             has_host_callback=has_cb,
+            has_sync_io=has_io,
             node=fn,
         )
         info.functions[summary.qualname] = summary
@@ -373,23 +379,34 @@ class ProjectIndex:
             target = owner.imports.get(symbol)  # re-export hop
         return None
 
-    # -- transitive callback taint ------------------------------------------
+    # -- transitive taints --------------------------------------------------
     def callback_tainted(self, summary: FunctionSummary) -> bool:
         """True when ``summary`` performs a host callback itself or reaches
         one through statically-resolvable project calls (fixpoint over the
         call graph; cycles resolve to False-unless-proven)."""
-        return self._tainted(summary.fq, frozenset())
+        return self._tainted(summary.fq, frozenset(),
+                             "has_host_callback", self._taint_cache)
 
-    def _tainted(self, fq: str, seen: frozenset) -> bool:
-        if fq in self._taint_cache:
-            return self._taint_cache[fq]
+    def io_tainted(self, summary: FunctionSummary) -> bool:
+        """Same closure, different mark: True when ``summary`` performs
+        synchronous host I/O (open/fsync/urlopen/socket — the
+        :data:`_common.SYNC_IO_CALLS` set) itself or reaches it through
+        project calls. JG020's input: the checkpoint write two calls
+        below a timed step loop is exactly what direct scanning misses."""
+        return self._tainted(summary.fq, frozenset(),
+                             "has_sync_io", self._io_taint_cache)
+
+    def _tainted(self, fq: str, seen: frozenset, mark: str,
+                 cache: Dict[str, bool]) -> bool:
+        if fq in cache:
+            return cache[fq]
         if fq in seen:
             return False
         summary = self.lookup(fq)
         if summary is None:
             return False
-        if summary.has_host_callback:
-            self._taint_cache[fq] = True
+        if getattr(summary, mark):
+            cache[fq] = True
             return True
         seen = seen | {fq}
         for callee in summary.calls:
@@ -406,10 +423,11 @@ class ProjectIndex:
                         break
                 if target is None:
                     continue
-            if self.lookup(target) is not None and self._tainted(target, seen):
-                self._taint_cache[fq] = True
+            if self.lookup(target) is not None and self._tainted(
+                    target, seen, mark, cache):
+                cache[fq] = True
                 return True
-        self._taint_cache[fq] = False
+        cache[fq] = False
         return False
 
 

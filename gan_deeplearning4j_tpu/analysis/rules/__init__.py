@@ -41,6 +41,9 @@ from gan_deeplearning4j_tpu.analysis.rules.state_spec import (
 from gan_deeplearning4j_tpu.analysis.rules.prefetch_callback import (
     PrefetchCallbackInTimedRegion,
 )
+from gan_deeplearning4j_tpu.analysis.rules.step_io import (
+    SyncHostIoOnStepPath,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -62,6 +65,7 @@ RULES = [
     UnboundedNetworkCall(),
     ShardedStateSpecMismatch(),
     PrefetchCallbackInTimedRegion(),
+    SyncHostIoOnStepPath(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,153 @@
+"""JG020 — synchronous host I/O on a timed train-step path.
+
+The measured stall behind this rule is real and on this tree's books:
+checkpoint writes are fsync-bound and synchronous, and on the toy
+resilience workload they cost 34% of wall (BENCH_resilience_r01.json) —
+the device *idles* while the host writes. The general hazard: a timed
+region that drives traced (jit/pmap/shard_map) step work also reaches
+``open``/``os.fsync``/``urllib.request.urlopen``/``socket.*`` somewhere
+down its call graph, so the step cadence (and every number measured over
+it) silently includes host I/O the accelerator cannot overlap.
+
+What makes this a *cross-module* rule: the I/O never sits in the step
+loop — it sits in a publish/log/upload helper two calls away. Phase 1's
+project index marks which functions perform sync I/O directly and the
+rule consults the TRANSITIVE closure (:meth:`ProjectIndex.io_tainted`),
+the same machinery JG009 uses for host callbacks.
+
+Scope discipline keeps the tree clean and the findings true: a region
+only qualifies as a *train-step* region when it both reads a wall clock
+(JG009's two region shapes: a clock-reading loop, or the straight-line
+span between two clock reads) AND calls something known to be traced —
+a project-index ``traced`` summary, a local ``step = jax.jit(...)``
+binding, or an inline ``jax.jit(f)(x)`` (JG015's detection). Deliberate
+I/O timing — the store's fsync-bound publish measured *on purpose*, a
+bench writing its artifact — has no traced call in the window and stays
+silent.
+
+True negatives the fixtures pin: I/O outside any timed region, timed
+I/O without step work (the supervisor's ``_publish`` shape), pure
+helpers, and reads that are part of the region's *protocol* rather than
+its work are not special-cased — move them out or suppress with a
+justification (the async-checkpoint ROADMAP item is the real fix).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gan_deeplearning4j_tpu.analysis import _common
+
+
+def _clock_lines(nodes, mod):
+    return sorted(
+        n.lineno
+        for n in _common.walk_excluding_defs(nodes)
+        if isinstance(n, ast.Call) and mod.resolve(n.func) in _common.CLOCK_CALLS
+    )
+
+
+class SyncHostIoOnStepPath:
+    code = "JG020"
+    name = "sync-host-io-on-step-path"
+    summary = ("synchronous file/network I/O reachable from a timed "
+               "train-step region — the device idles while the host "
+               "blocks, and the step measurement includes it")
+    skip_tests = True
+
+    def check(self, mod):
+        jitted_locals = self._jitted_names(mod)
+        reported = set()
+        # region 1: any loop that reads a clock
+        for loop in _common.iter_loops(mod.tree):
+            if _clock_lines(loop, mod):
+                calls = [
+                    n for n in _common.walk_excluding_defs(loop)
+                    if isinstance(n, ast.Call)
+                ]
+                yield from self._scan(mod, calls, jitted_locals, reported,
+                                      where="timed loop")
+        # region 2: the straight-line span between the first and last
+        # clock read of a function body (nested defs excluded)
+        for scope in _common.iter_scopes(mod.tree):
+            body = getattr(scope, "body", None)
+            if not body:
+                continue
+            lines = _clock_lines(body, mod)
+            if len(lines) < 2:
+                continue
+            lo, hi = lines[0], lines[-1]
+            span = [
+                n for n in _common.walk_excluding_defs(body)
+                if isinstance(n, ast.Call)
+                and lo <= getattr(n, "lineno", 0) <= hi
+            ]
+            yield from self._scan(mod, span, jitted_locals, reported,
+                                  where="timed span")
+
+    # -- "train-step": the region must drive traced work -------------------
+    def _jitted_names(self, mod):
+        names = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if (isinstance(value, ast.Call)
+                    and mod.resolve(value.func) in _common.TRACING_WRAPPERS):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        names.add(target.id)
+        return names
+
+    def _traced_call(self, call: ast.Call, mod, jitted_locals) -> bool:
+        if (isinstance(call.func, ast.Call)
+                and mod.resolve(call.func.func) in _common.TRACING_WRAPPERS):
+            return True  # inline jax.jit(f)(x)
+        if isinstance(call.func, ast.Name) and call.func.id in jitted_locals:
+            return True  # step = jax.jit(...); ...; step(x)
+        if mod.project is not None:
+            summary = mod.project.resolve_function(mod, call.func)
+            if summary is not None and summary.traced:
+                return True
+        return False
+
+    # -- the scan -----------------------------------------------------------
+    def _scan(self, mod, calls, jitted_locals, reported, where):
+        if not any(self._traced_call(c, mod, jitted_locals) for c in calls):
+            return  # timed, but not a train-step region — not ours
+        for call in calls:
+            if id(call) in reported:
+                continue
+            resolved = mod.resolve(call.func)
+            if resolved in _common.SYNC_IO_CALLS:
+                reported.add(id(call))
+                f = mod.finding(
+                    self.code,
+                    f"`{resolved}` inside a {where} that drives traced "
+                    f"step work — synchronous host I/O serializes the "
+                    f"step cadence (the device idles while the host "
+                    f"blocks; the fsync-bound checkpoint write measured "
+                    f"34% of wall on the toy workload); move the I/O off "
+                    f"the step path (background thread / post-loop)",
+                    call,
+                )
+                yield f, call
+                continue
+            if (mod.project is None or resolved in _common.CLOCK_CALLS
+                    or resolved in _common.HOST_CALLBACKS):
+                continue
+            summary = mod.project.resolve_function(mod, call.func)
+            if summary is not None and mod.project.io_tainted(summary):
+                reported.add(id(call))
+                f = mod.finding(
+                    self.code,
+                    f"`{ast.unparse(call.func)}` is called inside a "
+                    f"{where} that drives traced step work, and "
+                    f"`{summary.fq}` performs synchronous host I/O "
+                    f"(open/fsync/urlopen/socket), directly or through "
+                    f"its callees — the step measurement includes host "
+                    f"I/O the device cannot overlap; move it off the "
+                    f"step path or run it on a background thread",
+                    call,
+                )
+                yield f, call

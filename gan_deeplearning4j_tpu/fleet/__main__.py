@@ -77,7 +77,19 @@ def main(argv=None) -> int:
     p.add_argument("--canary-fid-slack", type=float, default=10.0)
     p.add_argument("--canary-acc-drop", type=float, default=0.05)
     p.add_argument("--telemetry", action="store_true",
-                   help="enable span tracing on the router/manager process")
+                   help="enable span tracing on the router/manager process "
+                        "AND every worker (GET /debug/trace then merges "
+                        "one fleet-wide Chrome trace)")
+    p.add_argument("--slo-availability", type=float, default=0.999,
+                   help="availability target (fraction answered non-5xx)")
+    p.add_argument("--slo-latency-ms", type=float, default=500.0,
+                   help="latency objective threshold in milliseconds")
+    p.add_argument("--slo-latency-target", type=float, default=0.99,
+                   help="fraction of answers that must beat the threshold")
+    p.add_argument("--slo-fast-window", type=float, default=60.0,
+                   help="fast burn-rate window seconds")
+    p.add_argument("--slo-slow-window", type=float, default=600.0,
+                   help="slow burn-rate window seconds")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -89,6 +101,7 @@ def main(argv=None) -> int:
         FleetRouter,
         make_router_server,
     )
+    from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig
     from gan_deeplearning4j_tpu.telemetry.trace import TRACER, configure_from_env
 
     if args.telemetry:
@@ -119,6 +132,13 @@ def main(argv=None) -> int:
             "consecutive_failures": args.eject_failures,
             "reopen_after": args.reopen_after,
         },
+        slo_config=SLOConfig(
+            availability_target=args.slo_availability,
+            latency_threshold_s=args.slo_latency_ms / 1e3,
+            latency_target=args.slo_latency_target,
+            fast_window_s=args.slo_fast_window,
+            slow_window_s=args.slo_slow_window,
+        ),
     )
     manager = FleetManager(
         router, args.store,
@@ -137,6 +157,7 @@ def main(argv=None) -> int:
             fid_slack=args.canary_fid_slack,
             accuracy_drop_max=args.canary_acc_drop,
         ),
+        telemetry=args.telemetry,
     )
     log = logging.getLogger(__name__)
     # bind the router port BEFORE spawning workers: a bind failure must

@@ -142,7 +142,8 @@ class FleetManager:
                  canary_feature: str = "raw",
                  thresholds: Optional[CanaryThresholds] = None,
                  probe_timeout_s: float = 600.0, probe_retries: int = 3,
-                 spawn=None, env: Optional[dict] = None):
+                 spawn=None, env: Optional[dict] = None,
+                 telemetry: bool = False):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         from gan_deeplearning4j_tpu.resilience.store import CheckpointStore
@@ -164,6 +165,10 @@ class FleetManager:
         self.thresholds = thresholds or CanaryThresholds()
         self.probe_timeout_s = probe_timeout_s
         self.probe_retries = probe_retries
+        # span tracing on every WORKER process too (--telemetry on the
+        # fleet CLI): without it the router's /debug/trace merge would
+        # hold router spans only — trace propagation needs both ends
+        self.telemetry = telemetry
         self._spawn = spawn or self._spawn_process
         self._env = env
         if ports is None:
@@ -300,11 +305,14 @@ class FleetManager:
 
     # -- process control -------------------------------------------------
     def _worker_cmd(self, slot: WorkerSlot, bundle_path: str) -> List[str]:
-        return SERVING_CLI + [
+        cmd = SERVING_CLI + [
             "--bundle", bundle_path,
             "--host", slot.host, "--port", str(slot.port),
             "--warmup", "eager",
-        ] + self.worker_args
+        ]
+        if self.telemetry:
+            cmd.append("--telemetry")
+        return cmd + self.worker_args
 
     def _spawn_process(self, slot: WorkerSlot, bundle_path: str
                        ) -> WorkerProcess:

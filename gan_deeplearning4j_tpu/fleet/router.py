@@ -31,6 +31,14 @@ the bounded-compile invariant is per worker and re-routing cannot break
 it (the drill asserts each worker's ``serve_compile_counts`` stays 0).
 Every network call here carries an explicit timeout — jaxlint JG017
 polices that on this path.
+
+The router is also the fleet's observability edge (docs/OBSERVABILITY.md
+"Fleet observability"): it stamps/adopts an ``X-Trace-Id`` per request
+and forwards it on every worker attempt (one causal chain across
+retries), serves the merged fleet registry at ``GET /metrics?scope=fleet``
+(JSON + Prometheus) and the merged fleet span trace at
+``GET /debug/trace``, and feeds every routed outcome into the SLO
+burn-rate tracker surfaced in ``/healthz``.
 """
 
 from __future__ import annotations
@@ -50,8 +58,22 @@ from gan_deeplearning4j_tpu.fleet.health import (
     http_json,
     probe_worker,
 )
+from gan_deeplearning4j_tpu.telemetry.aggregate import (
+    json_sanitize,
+    merge_snapshots,
+    merge_traces,
+    snapshot_to_prometheus,
+)
 from gan_deeplearning4j_tpu.telemetry.registry import get_registry
-from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig, SLOTracker
+from gan_deeplearning4j_tpu.telemetry.trace import (
+    TRACER,
+    bind_trace_id,
+    current_trace_id,
+    new_trace_id,
+    sanitize_trace_id,
+    unbind_trace_id,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -166,6 +188,7 @@ class WorkerRef:
             scraped = dict(self._scraped)
             inflight = self._inflight
             counts = dict(self.counts)
+        scraped_at = scraped.get("at")
         return {
             "id": self.id,
             "base_url": self.base_url,
@@ -176,6 +199,13 @@ class WorkerRef:
             "inflight": inflight,
             "generation": scraped.get("generation"),
             "queue_depth": scraped.get("queue_depth"),
+            # scrape staleness: a wedged /metrics endpoint shows up HERE
+            # (the age climbing past the probe interval) before the
+            # breaker's failure streak ever trips; None = never scraped
+            # since (re)launch
+            "last_scrape_age_s": (
+                round(time.monotonic() - scraped_at, 3)
+                if scraped_at is not None else None),
             "counts": counts,
         }
 
@@ -194,7 +224,8 @@ class FleetRouter:
                  retry_ratio: float = 0.2, retry_burst: float = 10.0,
                  max_attempts: int = 3, backoff_base: float = 0.02,
                  backoff_max: float = 0.25, seed: int = 0,
-                 breaker_kwargs: Optional[dict] = None):
+                 breaker_kwargs: Optional[dict] = None,
+                 slo_config: Optional[SLOConfig] = None):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self.request_timeout = request_timeout
@@ -229,6 +260,9 @@ class FleetRouter:
             "fleet_ejections_total", "circuit-breaker trips across workers")
         self._g_routable = registry.gauge(
             "fleet_workers_routable", "workers currently in the routable pool")
+        # SLO burn-rate tracking over every routed outcome — the healthz
+        # block and the admission signal (telemetry/slo.py)
+        self.slo = SLOTracker(slo_config)
 
     # -- worker registry -------------------------------------------------
     def add_worker(self, worker_id: str, base_url: str, pid=None
@@ -278,19 +312,56 @@ class FleetRouter:
         host, _, port = ref.base_url.rpartition("//")[2].partition(":")
         conn = http.client.HTTPConnection(host, int(port),
                                           timeout=self.request_timeout)
+        headers = {"Content-Type": "application/json"}
+        trace_id = current_trace_id()
+        if trace_id is not None:
+            # the propagation header: the worker's HTTP handler adopts it
+            # into ITS correlation contextvar, so worker-side spans carry
+            # the router's id — including a retry's second worker
+            headers["X-Trace-Id"] = trace_id
         try:
-            conn.request(method, path, body=body,
-                         headers={"Content-Type": "application/json"})
+            conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
             return resp.status, resp.read()
         finally:
             conn.close()
 
-    def handle(self, method: str, path: str, body: Optional[bytes]
-               ) -> Tuple[int, bytes]:
+    def handle(self, method: str, path: str, body: Optional[bytes],
+               trace_id: Optional[str] = None) -> Tuple[int, bytes]:
         """Route one ``/v1/*`` request: p2c pick, proxy, retry shed and
         connect-failed attempts on a different worker under the budget.
-        Always returns exactly one response."""
+        Always returns exactly one response.
+
+        ``trace_id`` is the client's ``X-Trace-Id`` (adopted when valid,
+        else a fresh id is minted). The id is bound to this thread's
+        correlation contextvar — the router's own route/attempt/retry
+        spans pick it up — and forwarded to every worker attempt, so one
+        request's spans merge into one causal chain across the router and
+        every worker it was tried on, retries included. The final outcome
+        and latency also feed the SLO tracker (5xx = availability
+        failure; latency is measured on answered requests only)."""
+        tid = sanitize_trace_id(trace_id) or new_trace_id()
+        token = bind_trace_id(tid)
+        t0 = time.perf_counter()
+        status = 500  # an exception out of _route IS a 500 for the SLO:
+        # the HTTP front end's catch-all answers the client 500, and the
+        # burn rate must see it — a router-side 500-storm that bypassed
+        # the tracker would leave fleet_slo_ok reporting healthy
+        try:
+            if TRACER.enabled:
+                with TRACER.span("fleet.route", path=path):
+                    status, payload = self._route(method, path, body)
+            else:
+                status, payload = self._route(method, path, body)
+            return status, payload
+        finally:
+            unbind_trace_id(token)
+            latency = time.perf_counter() - t0
+            self.slo.record(status < 500,
+                            latency if status < 500 else None)
+
+    def _route(self, method: str, path: str, body: Optional[bytes]
+               ) -> Tuple[int, bytes]:
         self.budget.deposit()
         with self._lock:
             self._counts["proxied"] += 1
@@ -311,6 +382,9 @@ class FleetRouter:
                     self._counts["retries"] += 1
                     jitter = 0.5 + self._rng.random() * 0.5
                 self._c_retries.inc()
+                if TRACER.enabled:
+                    TRACER.instant("fleet.retry", {
+                        "attempt": attempt, "reason": retryable})
                 delay = min(self.backoff_max,
                             self.backoff_base * (2 ** (attempt - 1)))
                 time.sleep(delay * jitter)
@@ -345,7 +419,7 @@ class FleetRouter:
             finally:
                 ref.end()
                 if TRACER.enabled:
-                    TRACER.complete("fleet.proxy", t0, time.perf_counter(),
+                    TRACER.complete("fleet.attempt", t0, time.perf_counter(),
                                     {"worker": ref.id, "path": path,
                                      "attempt": attempt})
             if status == 503:
@@ -429,6 +503,54 @@ class FleetRouter:
                 logger.exception("health pass failed")
             self._stop.wait(self.probe_interval)
 
+    # -- fleet-scale observability ---------------------------------------
+    def fleet_metrics_snapshot(self) -> dict:
+        """``GET /metrics?scope=fleet`` — fan out to every registered
+        worker's ``/metrics?scope=registry`` (samples included, so merged
+        histogram percentiles keep the nearest-rank contract), merge with
+        this router process's own registry, and return ONE snapshot.
+        A worker that fails to answer becomes a labeled gap
+        (``fleet_member_up{worker=...} 0``), never an error."""
+        self.slo.snapshot()  # refresh the burn-rate gauges into the scrape
+        parts: Dict[str, dict] = {}
+        gaps: List[str] = []
+        for ref, snap in self._fan_out("/metrics?scope=registry"):
+            if isinstance(snap, dict) and snap:
+                parts[ref.id] = snap
+            else:
+                gaps.append(ref.id)
+        parts["router"] = get_registry().snapshot(include_samples=True)
+        return merge_snapshots(parts, gaps=gaps)
+
+    def _fan_out(self, path: str):
+        """Concurrent bounded GETs of ``path`` on every registered worker:
+        [(ref, json_or_None)]. Concurrency matters — sequentially, K
+        unreachable workers would cost K × probe_timeout per fleet scrape;
+        fanned out the whole sweep is bounded by ~one probe_timeout."""
+        refs = self.workers()
+        if not refs:
+            return []
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(min(8, len(refs))) as pool:
+            return list(zip(refs, pool.map(
+                lambda ref: http_json(f"{ref.base_url}{path}",
+                                      timeout=self.probe_timeout),
+                refs)))
+
+    def fleet_trace(self) -> dict:
+        """``GET /debug/trace`` — ONE Chrome/Perfetto trace for the whole
+        fleet: this process's spans plus every worker's ``/debug/spans``,
+        concatenated (valid because every tracer pins timestamps to the
+        wall epoch and stamps its own pid — each process renders as its
+        own track, and one trace id threads a request across them)."""
+        docs: Dict[str, Optional[dict]] = {
+            "router": TRACER.chrome_trace({"source": "fleet.router"}),
+        }
+        for ref, doc in self._fan_out("/debug/spans"):
+            docs[ref.id] = doc
+        return merge_traces(docs, metadata={"source": "fleet"})
+
     # -- observability ---------------------------------------------------
     def healthz(self) -> dict:
         workers = [w.snapshot() for w in self.workers()]
@@ -445,6 +567,10 @@ class FleetRouter:
             # on, else None (mid-roll)
             "generation": generations[0] if len(generations) == 1 else None,
             "generations": generations,
+            # burn rates + the fail-closed admission signal — informational
+            # here ("status" stays routability-driven); the autoscaler and
+            # upgrade gate read slo["ok"]
+            "slo": self.slo.snapshot(),
         }
         if self.manager is not None:
             body["fleet"] = self.manager.status()
@@ -456,6 +582,7 @@ class FleetRouter:
         return {
             **counts,
             "retry_budget_tokens": self.budget.tokens,
+            "slo": self.slo.snapshot(),
             "workers": [w.snapshot() for w in self.workers()],
         }
 
@@ -474,20 +601,44 @@ def scrape_metrics(base_url: str, timeout: float = 2.0) -> Optional[dict]:
 class _RouterHandler(BaseHTTPRequestHandler):
     router: FleetRouter = None  # bound by make_router_server
 
-    def _respond(self, status: int, body: bytes) -> None:
+    def _respond(self, status: int, body: bytes,
+                 content_type: str = "application/json",
+                 extra_headers: Optional[dict] = None) -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (http.server naming contract)
         try:
-            route, _, _ = self.path.partition("?")
+            route, _, query = self.path.partition("?")
+            params = parse_qs(query) if query else {}
             if route == "/healthz":
                 self._respond(200, json.dumps(self.router.healthz()).encode())
             elif route == "/metrics":
-                self._respond(200, json.dumps(self.router.metrics()).encode())
+                if params.get("scope", [""])[0] == "fleet":
+                    snap = self.router.fleet_metrics_snapshot()
+                    if "prom" in params.get("format", []):
+                        self._respond(
+                            200, snapshot_to_prometheus(snap).encode(),
+                            content_type="text/plain; version=0.0.4; "
+                                         "charset=utf-8")
+                    else:
+                        # NaN (empty-window SLO gauges) → null: strict
+                        # JSON parsers reject a literal NaN token
+                        self._respond(200, json.dumps(
+                            json_sanitize(snap)).encode())
+                else:
+                    self._respond(200,
+                                  json.dumps(self.router.metrics()).encode())
+            elif route == "/debug/trace":
+                # the merged fleet trace (router spans + every worker's
+                # /debug/spans) as one Perfetto-loadable document
+                self._respond(200,
+                              json.dumps(self.router.fleet_trace()).encode())
             else:
                 self._respond(404, _json_body("error",
                                               f"no route GET {route}"))
@@ -502,8 +653,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else None
             if route.startswith("/v1/"):
-                status, payload = self.router.handle("POST", self.path, body)
-                self._respond(status, payload)
+                # adopt the client's trace id (sanitized) or mint one HERE
+                # so the response can echo the id the spans carry
+                tid = (sanitize_trace_id(self.headers.get("X-Trace-Id"))
+                       or new_trace_id())
+                status, payload = self.router.handle(
+                    "POST", self.path, body, trace_id=tid)
+                self._respond(status, payload,
+                              extra_headers={"X-Trace-Id": tid})
                 return
             if route == "/admin/poll" and self.router.manager is not None:
                 params = parse_qs(query) if query else {}

@@ -178,6 +178,9 @@ class MeshCoordinator:
         self._sleep = sleep
         self.mesh_dir = os.path.join(self.root, ".mesh", token)
         os.makedirs(self.mesh_dir, exist_ok=True)
+        # per-phase timings of the most recent publish (None until one
+        # lands) — the supervisor stamps these into its summary timeline
+        self.last_phases: Optional[dict] = None
         registry = get_registry()
         self._c_commits = registry.counter(
             "resilience_mesh_commits_total",
@@ -354,6 +357,7 @@ class MeshCoordinator:
             })
         else:
             number, staging = self._find_round(step)
+        t_announced = time.perf_counter()
 
         # -- phase 1: stage this worker's shard, then vote --------------
         if self.faults is not None:
@@ -378,6 +382,7 @@ class MeshCoordinator:
             "files": files,
         })
         _fsync_dir(staging)
+        t_staged = time.perf_counter()
         if self.faults is not None:
             self.faults.on_shard_staged(step)
 
@@ -389,13 +394,34 @@ class MeshCoordinator:
             # commit notification — no second marker to race with
             self._wait_for(lambda: os.path.isdir(final),
                            f"publication of generation {number}")
-        seconds = time.perf_counter() - t0
+        t_committed = time.perf_counter()
+        seconds = t_committed - t0
+        # per-phase attribution (docs/OBSERVABILITY.md "straggler
+        # attribution"): announce = round rendezvous (a worker whose
+        # peers lag waits HERE), stage = this worker writing + hashing
+        # its own shard (a straggler's time lands HERE), commit_wait =
+        # votes + re-hash + rename on the coordinator, or the publication
+        # barrier on everyone else (the fast writers' wait on the
+        # straggler lands HERE). Stamped into the supervisor's summary
+        # timeline and, when tracing, into per-phase spans that
+        # trace_report's barrier table folds by (gen, worker).
+        self.last_phases = {
+            "announce_s": t_announced - t0,
+            "stage_s": t_staged - t_announced,
+            "commit_wait_s": t_committed - t_staged,
+        }
         self._h_commit.observe(seconds)
         self._g_generation.set(number)
-        TRACER.complete("resilience.mesh_publish", t0, time.perf_counter(),
-                        {"gen": number, "step": int(step),
+        if TRACER.enabled:
+            span_args = {"gen": number, "step": int(step),
                          "worker": self.worker,
-                         "coordinator": self.is_coordinator})
+                         "coordinator": self.is_coordinator}
+            TRACER.complete("resilience.mesh_stage", t_announced, t_staged,
+                            dict(span_args))
+            TRACER.complete("resilience.mesh_commit_wait", t_staged,
+                            t_committed, dict(span_args))
+            TRACER.complete("resilience.mesh_publish", t0, t_committed,
+                            span_args)
         with open(os.path.join(final, MANIFEST_NAME)) as fh:
             manifest = json.load(fh)
         return Generation(number=number, path=final, manifest=manifest)

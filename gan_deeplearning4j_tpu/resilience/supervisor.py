@@ -38,6 +38,7 @@ import dataclasses
 import logging
 import signal
 import time
+from collections import deque
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -156,6 +157,12 @@ class TrainingSupervisor:
         # count, files) — surfaced in the summary so drill invariant
         # messages can name the owning worker on a shard mismatch
         self._last_shard: Optional[dict] = None
+        # per-phase step timeline (bounded: newest entries win) — one
+        # record per train step and per publish, stamped with wall-epoch
+        # start times so N workers' summaries line up on one clock for
+        # straggler attribution (scripts/trace_report.py folds the same
+        # story from merged traces; the summary is the trace-free form)
+        self._timeline: deque = deque(maxlen=4096)
         # telemetry registry series (docs/OBSERVABILITY.md); the events
         # list above remains the drill's per-run record
         registry = get_registry()
@@ -245,6 +252,21 @@ class TrainingSupervisor:
             "event": "publish", "generation": generation.number,
             "step": exp.batch_counter, "seconds": seconds,
         }
+        phases = (getattr(self.mesh, "last_phases", None)
+                  if self.mesh is not None else None)
+        if phases is not None:
+            # announce/stage/commit_wait breakdown (resilience/mesh.py):
+            # names whether THIS worker was the slow shard writer or the
+            # one waiting at the publication barrier
+            event["phases"] = dict(phases)
+        self._timeline.append({
+            "phase": "publish", "step": exp.batch_counter,
+            "start_unix_s": round(time.time() - seconds, 6),
+            "seconds": round(seconds, 6),
+            "generation": generation.number,
+            **({"phases": {k: round(v, 6) for k, v in phases.items()}}
+               if phases is not None else {}),
+        })
         if self.mesh is not None:
             # surface which updater shard this worker wrote — until now
             # only the file names encoded it, so a drill shard mismatch
@@ -427,10 +449,16 @@ class TrainingSupervisor:
             if self.faults is not None:
                 self.faults.on_step(exp.batch_counter)
             feats, labels = self.batch_at(exp.batch_counter)
+            t_wall = time.time()
             t = time.perf_counter()
             exp.train_iteration(feats, labels)
             t_end = time.perf_counter()
             train_s += t_end - t
+            self._timeline.append({
+                "phase": "step", "step": exp.batch_counter,
+                "start_unix_s": round(t_wall, 6),
+                "seconds": round(t_end - t, 6),
+            })
             if TRACER.enabled:  # don't build per-step args when off
                 TRACER.complete(
                     "resilience.step", t, t_end,
@@ -470,5 +498,12 @@ class TrainingSupervisor:
             "serve_publish_count": (serve or {}).get("count", 0),
             "final_serve_generation": (serve or {}).get("generation"),
             "updater_shard": self._last_shard,
+            # per-phase step/publish timeline on the wall clock — mesh
+            # workers' summaries line up into one cross-worker story
+            # (worker identity travels alongside, in the CLI's summary
+            # envelope); bounded to the newest 4096 records
+            "step_timeline": list(self._timeline),
+            "worker": getattr(self.mesh, "worker", None),
+            "world_size": getattr(self.mesh, "world_size", None),
             "events": list(self.events),
         }

@@ -12,7 +12,13 @@ framework dependency, per the repo's no-new-deps rule). Endpoints:
 - ``GET  /metrics``      request counters, p50/p95/p99 latency, batch-
   occupancy histogram, shed counts, per-kind compile counts, generation;
   ``?format=prom`` switches to Prometheus text exposition straight off the
-  process-wide telemetry registry (docs/OBSERVABILITY.md)
+  process-wide telemetry registry (docs/OBSERVABILITY.md);
+  ``?scope=registry`` returns the raw registry snapshot with histogram
+  samples — the fleet router's aggregation feed (``telemetry/aggregate``)
+- ``X-Trace-Id`` on ``POST`` requests propagates a correlation id: the
+  handler adopts a valid header value instead of minting, so this
+  worker's spans join the fleet router's (or any upstream's) causal
+  chain in a merged trace
 - ``POST /debug/trace?ms=N``  on-demand ``jax.profiler`` device capture
   into the service's artifacts dir — 202 + the artifact path (async;
   ``block=1`` waits for 200), 409 while one is running
@@ -48,6 +54,7 @@ from gan_deeplearning4j_tpu.telemetry.trace import (
     TRACER,
     bind_trace_id,
     new_trace_id,
+    sanitize_trace_id,
     unbind_trace_id,
 )
 
@@ -230,17 +237,28 @@ class InferenceService:
             return 200, {"status": "ok", "reload": status}
         return 202, {"status": "accepted", "reload": status}
 
-    def handle(self, method: str, path: str, payload: Optional[dict] = None
-               ) -> Tuple[int, dict]:
+    def handle(self, method: str, path: str, payload: Optional[dict] = None,
+               trace_id: Optional[str] = None) -> Tuple[int, dict]:
         """(http_status, response_body) for one request — the single routing
         table both front ends use. (``/metrics?format=prom`` is the one
         route with a non-JSON body; the HTTP front end serves it from
-        :meth:`metrics_text` before reaching this table.)"""
+        :meth:`metrics_text` before reaching this table.)
+
+        ``trace_id`` is a propagated correlation id (the fleet router's —
+        or any client's — ``X-Trace-Id`` header): when valid it is adopted
+        as this request's correlation id instead of minting one, so this
+        worker's spans join the caller's causal chain in a merged trace."""
         path, _, query = path.partition("?")
         params = parse_qs(query) if query else {}
         if method == "GET" and path == "/healthz":
             return 200, self.healthz()
         if method == "GET" and path == "/metrics":
+            if params.get("scope", [""])[0] == "registry":
+                # the fleet aggregation feed: the full process registry
+                # WITH histogram samples, so the router's merge can keep
+                # the nearest-rank percentile contract fleet-wide
+                # (telemetry/aggregate.py)
+                return 200, get_registry().snapshot(include_samples=True)
             return 200, self.metrics()
         if method == "GET" and path == "/debug/spans":
             return 200, TRACER.chrome_trace(
@@ -291,8 +309,11 @@ class InferenceService:
             if TRACER.enabled:
                 # one correlation id per request: the batcher's submit
                 # picks it off the contextvar and carries it across the
-                # pipeline's threads
-                token = bind_trace_id(new_trace_id())
+                # pipeline's threads. A propagated id (the router's
+                # X-Trace-Id) is adopted so retried attempts on two
+                # workers share one causal chain; otherwise mint
+                token = bind_trace_id(
+                    sanitize_trace_id(trace_id) or new_trace_id())
                 try:
                     with TRACER.span("serve.request", kind=kind,
                                      rows=int(rows.shape[0])):
@@ -357,7 +378,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(400, {"status": "error", "error": f"bad JSON: {exc}"})
             return
         try:
-            status, body = self.service.handle("POST", self.path, payload)
+            # the propagation header (docs/OBSERVABILITY.md): adopt the
+            # router's/client's correlation id into this request's spans
+            status, body = self.service.handle(
+                "POST", self.path, payload,
+                trace_id=self.headers.get("X-Trace-Id"))
         except Exception as exc:
             logger.exception("POST %s failed", self.path)
             status, body = 500, {"status": "error",

@@ -13,6 +13,17 @@ harness, serving, resilience, the bench scripts):
 - :mod:`.device` — on-demand ``jax.profiler`` captures behind the serving
   API's ``POST /debug/trace`` and the supervisor CLI's SIGUSR2.
 
+Two fleet-scale layers sit on that substrate (docs/OBSERVABILITY.md
+"Fleet observability"):
+
+- :mod:`.aggregate` — merge per-process registry snapshots into ONE
+  fleet snapshot (counters summed, gauges worker-labeled, histogram
+  percentiles recomputed over pooled samples) and per-process span
+  traces into ONE Chrome trace; the router's ``GET /metrics?scope=fleet``
+  and ``GET /debug/trace``.
+- :mod:`.slo` — multi-window availability/latency burn rates over
+  router-observed outcomes; empty windows fail closed.
+
 The package namespace is LAZY (PEP 562) like the project root: importing
 it must not import jax — ``registry``/``trace`` are stdlib-only and the
 analyzer and bench parent depend on that; only :mod:`.device` touches jax,
@@ -42,6 +53,16 @@ _LAZY_EXPORTS = {
                         "unbind_trace_id"),
     "configure_from_env": ("gan_deeplearning4j_tpu.telemetry.trace",
                            "configure_from_env"),
+    "sanitize_trace_id": ("gan_deeplearning4j_tpu.telemetry.trace",
+                          "sanitize_trace_id"),
+    "merge_snapshots": ("gan_deeplearning4j_tpu.telemetry.aggregate",
+                        "merge_snapshots"),
+    "snapshot_to_prometheus": ("gan_deeplearning4j_tpu.telemetry.aggregate",
+                               "snapshot_to_prometheus"),
+    "merge_traces": ("gan_deeplearning4j_tpu.telemetry.aggregate",
+                     "merge_traces"),
+    "SLOConfig": ("gan_deeplearning4j_tpu.telemetry.slo", "SLOConfig"),
+    "SLOTracker": ("gan_deeplearning4j_tpu.telemetry.slo", "SLOTracker"),
     "capture_device_trace": ("gan_deeplearning4j_tpu.telemetry.device",
                              "capture_device_trace"),
     "capture_async": ("gan_deeplearning4j_tpu.telemetry.device",

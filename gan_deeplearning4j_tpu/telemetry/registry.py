@@ -213,6 +213,11 @@ def _prom_labels(labels: Dict[str, str], extra: Optional[dict] = None) -> str:
 
 def _fmt(v: float) -> str:
     f = float(v)
+    if math.isnan(f):
+        return "NaN"  # Prometheus text form (an SLO burn rate with an
+        # empty window exports as NaN, not as a crash in int())
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
@@ -272,18 +277,28 @@ class MetricsRegistry:
         allocation on the hot path)."""
         return sum(len(f.series()) for f in self.families())
 
-    def snapshot(self) -> dict:
+    def snapshot(self, include_samples: bool = False) -> dict:
         """JSON-ready view: the payload embedded in ``/metrics`` and in
-        BENCH artifacts (``serve_bench --record`` / ``resilience_drill``)."""
+        BENCH artifacts (``serve_bench --record`` / ``resilience_drill``).
+
+        ``include_samples`` additionally exports each histogram's raw
+        sample deque — the form the fleet aggregator needs so merged
+        percentiles keep the nearest-rank contract (percentiles cannot be
+        merged from quantiles; they CAN be recomputed from the union of
+        samples — :mod:`.aggregate`)."""
         out: dict = {}
         for fam in self.families():
             series = []
             for labels, s in fam.series():
                 if fam.kind == "histogram":
-                    series.append({
+                    entry = {
                         "labels": labels, "count": s.count, "sum": s.total,
                         **s.percentiles(),
-                    })
+                    }
+                    if include_samples:
+                        with s._lock:
+                            entry["samples"] = list(s.samples)
+                    series.append(entry)
                 else:
                     series.append({"labels": labels, "value": s.value})
             out[fam.name] = {"type": fam.kind, "help": fam.help,

@@ -39,6 +39,7 @@ import contextvars
 import itertools
 import json
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -64,6 +65,23 @@ def new_trace_id() -> str:
     """Process-unique correlation id (pid-prefixed so ids from merged
     multi-process traces never collide)."""
     return f"{_PID:x}-{next(_ids):x}"
+
+
+#: the X-Trace-Id header contract (docs/OBSERVABILITY.md): short, shell-
+#: and log-safe. Anything else from a client is ignored, not echoed — a
+#: header is attacker-controlled input and these ids land verbatim in
+#: traces, logs, and span args.
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._:\-]{1,128}$")
+
+
+def sanitize_trace_id(value) -> Optional[str]:
+    """A client/peer-supplied trace id, validated — or None. The router
+    and the serving HTTP handler adopt a propagated id only through this
+    gate; an invalid one falls back to minting."""
+    if not isinstance(value, str):
+        return None
+    value = value.strip()
+    return value if _TRACE_ID_RE.match(value) else None
 
 
 def current_trace_id() -> Optional[str]:

@@ -23,7 +23,17 @@ subprocesses under closed-loop load through the router:
 5. **poison** — a digest-valid but quality-garbage generation is
    published. The fleet admission gate must reject it, quarantine it
    through the store (fleet-wide, once), and no worker may ever serve it.
-6. **ledger** — every submitted request got exactly one answer, zero
+6. **trace propagation + fleet aggregation** — a deliberately-retried
+   request (a client deadline no worker can meet, so every attempt sheds
+   and the router re-routes it) must leave spans carrying ONE trace id on
+   the router and at least two distinct worker pids in the router's
+   merged ``GET /debug/trace``; ``trace_report`` must fold that merged
+   trace with rc 0. ``GET /metrics?scope=fleet`` (JSON and Prometheus)
+   must sum per-worker request counters EXACTLY against simultaneous
+   direct worker scrapes, and the router's own ok counter must equal the
+   load ledger's ok count — the zero-lost ledger and the aggregated
+   metrics are the same numbers or one of them is lying.
+7. **ledger** — every submitted request got exactly one answer, zero
    lost, client-visible 503s bounded by the router's own honest-503
    counters (the retry-budget contract), zero 5xx, and every worker's
    ``serve_compile_counts`` stays 0 (re-routing cannot break the
@@ -198,6 +208,133 @@ def router_worker(health: dict, worker_id: str) -> dict:
     return {}
 
 
+def run_trace_phase(base: str, z_size: int, worker_pids: set,
+                    trace_out: str, invariants: dict) -> dict:
+    """Phase 5a — prove one trace id threads a retried request across the
+    router and two distinct worker processes. The probe request carries a
+    client deadline no worker can meet (1 µs), so every attempt sheds
+    with a worker-side ``serve.request`` span and the router re-routes to
+    a different worker; the router's merged ``GET /debug/trace`` must
+    then show the id on ≥2 worker pids plus the router's own spans, and
+    ``trace_report`` must fold the merged artifact with rc 0."""
+    rows = [[0.0] * z_size]
+    chosen = None
+    observed: dict = {}
+    for attempt in range(5):
+        tid = f"drill-retry-{attempt}"
+        status, _ = http_json(
+            "POST", f"{base}/v1/sample", {"data": rows, "timeout": 1e-6},
+            timeout=30.0, headers={"X-Trace-Id": tid})
+        _, merged = http_json("GET", f"{base}/debug/trace", timeout=20.0)
+        events = (merged or {}).get("traceEvents") or []
+        pids = {e.get("pid") for e in events
+                if (e.get("args") or {}).get("trace_id") == tid}
+        observed = {
+            "trace_id": tid, "probe_status": status,
+            "pids_with_id": sorted(p for p in pids if p is not None),
+            "worker_pids": sorted(worker_pids),
+            "merged_events": len(events),
+        }
+        if len(pids & worker_pids) >= 2 and (pids - worker_pids):
+            chosen = merged
+            break
+        time.sleep(0.3)
+    invariants["trace_one_id_spans_router_and_two_workers"] = (
+        chosen is not None)
+    rc = None
+    if chosen is not None:
+        with open(trace_out, "w") as fh:
+            json.dump(chosen, fh)
+            fh.write("\n")
+        report = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts",
+                                          "trace_report.py"), trace_out],
+            capture_output=True, text=True, timeout=120.0)
+        rc = report.returncode
+        log(f"trace_report on merged fleet trace: rc={rc}")
+        observed["trace_out"] = trace_out
+    invariants["trace_report_folds_merged_trace"] = rc == 0
+    observed["trace_report_rc"] = rc
+    return observed
+
+
+def _counter_total(snapshot: dict, family: str, match=None) -> float:
+    total = 0.0
+    for s in ((snapshot or {}).get(family) or {}).get("series", []):
+        labels = s.get("labels") or {}
+        if match and any(labels.get(k) != v for k, v in match.items()):
+            continue
+        total += float(s.get("value", 0.0))
+    return total
+
+
+def run_aggregation_phase(base: str, worker_ports: list, counts: dict,
+                          invariants: dict) -> dict:
+    """Phase 5b — the aggregation-exactness story, on frozen counters:
+    the fleet-scope snapshot (JSON and Prometheus) must sum per-worker
+    ``serve_requests_total`` EXACTLY against simultaneous direct worker
+    scrapes, report zero scrape gaps, and the router's own ok counter
+    must equal the load ledger's ok count. Also checks the satellite
+    surfaces: SLO block and per-worker scrape staleness in /healthz."""
+    import urllib.request
+
+    direct_total = 0.0
+    per_worker: dict = {}
+    for port in worker_ports:
+        _, snap = http_json(
+            "GET", f"http://127.0.0.1:{port}/metrics?scope=registry",
+            timeout=10.0)
+        t = _counter_total(snap, "serve_requests_total")
+        per_worker[str(port)] = t
+        direct_total += t
+    _, fleet_snap = http_json("GET", f"{base}/metrics?scope=fleet",
+                              timeout=30.0)
+    fleet_snap = fleet_snap or {}
+    fleet_total = _counter_total(fleet_snap, "serve_requests_total")
+    router_ok = _counter_total(fleet_snap, "fleet_requests_total",
+                               match={"outcome": "ok"})
+    gaps = (fleet_snap.get("_fleet") or {}).get("gaps")
+
+    prom_total = None
+    try:
+        with urllib.request.urlopen(
+                f"{base}/metrics?scope=fleet&format=prom",
+                timeout=30.0) as resp:
+            prom_text = resp.read().decode()
+        prom_total = sum(
+            float(line.rsplit(" ", 1)[1])
+            for line in prom_text.splitlines()
+            if line.startswith("serve_requests_total{"))
+    except (OSError, ValueError):
+        pass
+
+    health = fleet_health(base)
+    slo = health.get("slo") or {}
+    ages = [w.get("last_scrape_age_s") for w in health.get("workers", [])]
+
+    invariants["fleet_counter_sum_exact"] = (
+        fleet_total == direct_total > 0)
+    invariants["fleet_prom_matches_json"] = prom_total == fleet_total
+    invariants["fleet_scrape_no_gaps"] = gaps == []
+    invariants["router_ok_counter_matches_ledger"] = (
+        router_ok == counts["ok"])
+    invariants["slo_surfaced_with_traffic"] = (
+        (slo.get("totals") or {}).get("requests", 0) >= counts["sent"])
+    invariants["worker_scrape_age_surfaced"] = bool(ages) and all(
+        isinstance(a, (int, float)) for a in ages)
+    return {
+        "per_worker_requests": per_worker,
+        "direct_total": direct_total,
+        "fleet_total": fleet_total,
+        "prom_total": prom_total,
+        "router_ok": router_ok,
+        "ledger_ok": counts["ok"],
+        "gaps": gaps,
+        "slo": slo,
+        "last_scrape_age_s": ages,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
@@ -209,6 +346,10 @@ def main(argv=None) -> int:
     p.add_argument("--keep-last", type=int, default=10)
     p.add_argument("--workdir", default=None,
                    help="keep work files here instead of a temp dir")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="where the merged fleet Chrome trace lands "
+                        "(default: <workdir>/fleet_trace.json); "
+                        "tpu_campaign.sh gates trace_report on it")
     p.add_argument("--output", default=None, metavar="PATH")
     p.add_argument("--record", default=None, metavar="TAG",
                    help="also write BENCH_fleet_<TAG>.json at the repo root")
@@ -401,10 +542,25 @@ def main(argv=None) -> int:
             poison not in monitor.generations_served
             and after.get("generation") == final_gen)
 
-        # -- phase 5: ledgers -------------------------------------------
+        # -- phase 5: trace propagation + fleet aggregation -------------
+        # quiesce first: with the load generator stopped, per-worker
+        # counters are frozen, so the exactness assertions below compare
+        # stable numbers instead of racing live traffic
         counts = load.finish()
         load = None
         monitor.finish()
+        trace_out = args.trace_out or os.path.join(workdir,
+                                                   "fleet_trace.json")
+        health_now = fleet_health(base)
+        worker_pids = {w.get("pid")
+                       for w in (health_now.get("fleet") or {})
+                       .get("workers", []) if w.get("pid")}
+        results["trace"] = run_trace_phase(base, z_size, worker_pids,
+                                           trace_out, invariants)
+        results["fleet_metrics"] = run_aggregation_phase(
+            base, worker_ports, counts, invariants)
+
+        # -- phase 6: ledgers -------------------------------------------
         _, router_metrics = http_json("GET", f"{base}/metrics", timeout=5.0)
         router_metrics = router_metrics or {}
         results["requests"] = counts

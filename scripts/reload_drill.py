@@ -75,11 +75,15 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def http_json(method: str, url: str, payload=None, timeout: float = 10.0):
-    """(status, decoded JSON body) — None body on connection failure."""
+def http_json(method: str, url: str, payload=None, timeout: float = 10.0,
+              headers=None):
+    """(status, decoded JSON body) — None body on connection failure.
+    ``headers`` adds/overrides request headers (the fleet drill's
+    X-Trace-Id propagation probe)."""
     data = None if payload is None else json.dumps(payload).encode()
     req = urllib.request.Request(url, data=data, method=method,
-                                 headers={"Content-Type": "application/json"})
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, json.loads(resp.read())

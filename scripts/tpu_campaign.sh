@@ -102,8 +102,20 @@ fi
 # (enforced by the drill's own exit code). Pinned to CPU so it never
 # touches the chip.
 if ! JAX_PLATFORMS=cpu timeout 1800 python scripts/fleet_drill.py --smoke \
-    --output artifacts/fleet_smoke.json > fleet_smoke.log 2>&1; then
+    --output artifacts/fleet_smoke.json \
+    --trace-out artifacts/fleet_smoke_trace.json > fleet_smoke.log 2>&1; then
   echo "$(date +%H:%M:%S) fleet drill smoke failed — campaign aborted (see fleet_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
+# The fleet's merged trace must fold: the drill already asserted one
+# trace id spans the router + two worker pids; this gate re-runs
+# trace_report standalone on the artifact so a regression in the fold
+# path itself (not just the drill's inline call) aborts the campaign.
+if ! timeout 120 python scripts/trace_report.py \
+    artifacts/fleet_smoke_trace.json \
+    --json artifacts/fleet_smoke_trace_report.json \
+    > fleet_trace_report.log 2>&1; then
+  echo "$(date +%H:%M:%S) fleet trace_report gate failed — campaign aborted (see fleet_trace_report.log)" >> tpu_poller.log
   exit 1
 fi
 bench_done=0

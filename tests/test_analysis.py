@@ -2126,6 +2126,148 @@ class TestPrefetchCallbackInTimedRegion:
 
 
 # ===========================================================================
+# JG020 — synchronous host I/O on a timed train-step path
+# ===========================================================================
+
+class TestSyncHostIoOnStepPath:
+    def test_true_positive_checkpoint_write_via_taint_closure(self):
+        # the real measured stall: a publish helper (open/write/fsync)
+        # called from the timed step loop — the I/O is two calls away
+        # from the loop, visible only through the index's taint closure
+        r = run(
+            "import time\n"
+            "import os\n"
+            "import jax\n"
+            "def publish(state, path):\n"
+            "    with open(path, 'wb') as fh:\n"
+            "        fh.write(state)\n"
+            "        os.fsync(fh.fileno())\n"
+            "def train(step_fn, xs):\n"
+            "    step = jax.jit(step_fn)\n"
+            "    t0 = time.perf_counter()\n"
+            "    for x in xs:\n"
+            "        out = step(x)\n"
+            "        publish(out, 'ckpt.bin')\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert codes(r) == ["JG020"]
+        assert "synchronous host I/O" in r.active[0].message
+
+    def test_true_positive_direct_io_in_clock_reading_loop(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def train(step_fn, xs, log):\n"
+            "    step = jax.jit(step_fn)\n"
+            "    times = []\n"
+            "    for x in xs:\n"
+            "        t0 = time.perf_counter()\n"
+            "        out = step(x)\n"
+            "        open(log, 'a').write(str(out))\n"
+            "        times.append(time.perf_counter() - t0)\n"
+            "    return times\n"
+        )
+        assert "JG020" in codes(r)
+
+    def test_true_positive_network_upload_through_helper(self):
+        r = run(
+            "import time\n"
+            "import urllib.request\n"
+            "import jax\n"
+            "def upload(payload, url):\n"
+            "    return urllib.request.urlopen(url, data=payload, timeout=5.0)\n"
+            "def train(step_fn, xs, url):\n"
+            "    step = jax.jit(step_fn)\n"
+            "    t0 = time.perf_counter()\n"
+            "    for x in xs:\n"
+            "        upload(step(x), url)\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert codes(r) == ["JG020"]
+
+    def test_true_negative_timed_publish_without_step_work(self):
+        # the supervisor's _publish shape: a clock delta around the
+        # store publish on purpose — fsync-bound and MEASURED AS SUCH,
+        # no traced call in the window, not a step-path finding
+        r = run(
+            "import time\n"
+            "import os\n"
+            "def publish(state, path):\n"
+            "    with open(path, 'wb') as fh:\n"
+            "        fh.write(state)\n"
+            "        os.fsync(fh.fileno())\n"
+            "def timed_publish(state):\n"
+            "    t0 = time.perf_counter()\n"
+            "    publish(state, 'ckpt.bin')\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_io_outside_the_timed_region(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def train(step_fn, xs, log):\n"
+            "    step = jax.jit(step_fn)\n"
+            "    t0 = time.perf_counter()\n"
+            "    outs = [step(x) for x in xs]\n"
+            "    dt = time.perf_counter() - t0\n"
+            "    with open(log, 'w') as fh:\n"
+            "        fh.write(str(dt))\n"
+            "    return outs\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_pure_helper_is_not_io(self):
+        r = run(
+            "import time\n"
+            "import numpy as np\n"
+            "import jax\n"
+            "def summarize(out):\n"
+            "    return float(np.mean(out))\n"
+            "def train(step_fn, xs):\n"
+            "    step = jax.jit(step_fn)\n"
+            "    t0 = time.perf_counter()\n"
+            "    acc = [summarize(step(x)) for x in xs]\n"
+            "    return acc, time.perf_counter() - t0\n"
+        )
+        assert codes(r) == []
+
+    def test_skips_test_modules(self):
+        r = run(
+            "import time\n"
+            "import jax\n"
+            "def test_step_and_log(step_fn, xs):\n"
+            "    step = jax.jit(step_fn)\n"
+            "    t0 = time.perf_counter()\n"
+            "    for x in xs:\n"
+            "        open('log', 'a').write(str(step(x)))\n"
+            "    assert time.perf_counter() - t0 < 1\n",
+            path="tests/test_fx.py",
+        )
+        assert "JG020" not in codes(r)
+
+    def test_suppression_applies(self):
+        r = run(
+            "import time\n"
+            "import os\n"
+            "import jax\n"
+            "def publish(state, path):\n"
+            "    with open(path, 'wb') as fh:\n"
+            "        fh.write(state)\n"
+            "        os.fsync(fh.fileno())\n"
+            "def train(step_fn, xs):\n"
+            "    step = jax.jit(step_fn)\n"
+            "    t0 = time.perf_counter()\n"
+            "    for x in xs:\n"
+            "        publish(step(x), 'c.bin')  # jaxlint: disable=JG020\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert "JG020" not in codes(r)
+        assert "JG020" in [f.code for f in r.suppressed]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

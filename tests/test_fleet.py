@@ -48,6 +48,18 @@ class _Behavior:
         self.draining = False
         self.lock = threading.Lock()
         self.hits = 0  # /v1 requests that reached this worker
+        self.trace_ids = []  # X-Trace-Id headers seen on /v1 requests
+        # what GET /metrics?scope=registry answers (the aggregation feed);
+        # None = 404, exercising the labeled-gap path
+        self.registry_snapshot = {
+            "serve_requests_total": {
+                "type": "counter", "help": "x",
+                "series": [{"labels": {"kind": "sample", "status": "ok"},
+                            "value": 0.0}],
+            },
+        }
+        # what GET /debug/spans answers (the merged-trace feed)
+        self.spans = {"traceEvents": []}
 
 
 class _FakeWorkerHandler(BaseHTTPRequestHandler):
@@ -66,6 +78,13 @@ class _FakeWorkerHandler(BaseHTTPRequestHandler):
         if self.path.startswith("/healthz"):
             status = "draining" if b.draining else b.health
             self._send(200, {"status": status, "generation": b.generation})
+        elif self.path.startswith("/debug/spans"):
+            self._send(200, b.spans)
+        elif "scope=registry" in self.path:
+            if b.registry_snapshot is None:
+                self._send(404, {"status": "error", "error": "no registry"})
+            else:
+                self._send(200, b.registry_snapshot)
         else:
             self._send(200, {
                 "queue_depth": b.queue_depth,
@@ -85,6 +104,9 @@ class _FakeWorkerHandler(BaseHTTPRequestHandler):
             return
         with b.lock:
             b.hits += 1
+            tid = self.headers.get("X-Trace-Id")
+            if tid:
+                b.trace_ids.append(tid)
         if b.mode == "die":
             # the mid-request death shape: the connection drops with no
             # response bytes — the client sees a reset/BadStatusLine
@@ -774,3 +796,293 @@ class TestFleetDrill:
         assert payload["ok"]
         assert payload["invariants"]["exactly_one_answer_zero_lost"]
         assert payload["invariants"]["poison_never_served"]
+
+
+# ===========================================================================
+# fleet observability: trace propagation, aggregation, SLO, staleness
+# (ISSUE-11)
+# ===========================================================================
+
+class TestTracePropagation:
+    def test_client_trace_id_forwarded_to_worker(self, spawn_worker):
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        status, _ = r.handle("POST", "/v1/sample",
+                             json.dumps({"data": [[0.5]]}).encode(),
+                             trace_id="client-abc.1")
+        assert status == 200
+        assert b.trace_ids == ["client-abc.1"]
+
+    def test_minted_id_when_client_sends_none_or_garbage(self, spawn_worker):
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        r.handle("POST", "/v1/sample",
+                 json.dumps({"data": [[0.5]]}).encode())
+        r.handle("POST", "/v1/sample",
+                 json.dumps({"data": [[0.5]]}).encode(),
+                 trace_id="bad id\nwith junk")
+        assert len(b.trace_ids) == 2
+        for tid in b.trace_ids:
+            assert tid and "\n" not in tid and " " not in tid
+        assert "bad id\nwith junk" not in b.trace_ids
+
+    def test_retried_request_carries_one_id_across_workers(
+            self, spawn_worker):
+        from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
+        shedding, p1 = spawn_worker()
+        healthy, p2 = spawn_worker()
+        shedding.mode = "shed"
+        TRACER.enable()
+        r = _router(seed=3)
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        # drive until a request lands on the shedder first and is retried
+        # onto the healthy worker (p2c randomness; bounded attempts)
+        for i in range(40):
+            tid = f"retry-case-{i}"
+            status, _ = r.handle(
+                "POST", "/v1/sample",
+                json.dumps({"data": [[0.5]]}).encode(), trace_id=tid)
+            assert status == 200
+            if tid in shedding.trace_ids and tid in healthy.trace_ids:
+                break
+        else:
+            pytest.fail("no request was retried across both workers")
+        # the router's own spans carry the same id: route + 2 attempts
+        events = [e for e in TRACER.events()
+                  if (e.get("args") or {}).get("trace_id") == tid]
+        names = {e["name"] for e in events}
+        assert "fleet.route" in names
+        assert "fleet.attempt" in names
+        attempts = [e for e in events if e["name"] == "fleet.attempt"]
+        assert {a["args"]["worker"] for a in attempts} == {"w0", "w1"}
+
+    def test_http_front_end_echoes_trace_id_header(self, spawn_worker):
+        import http.client as hc
+
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        srv = make_router_server(r, port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            conn = hc.HTTPConnection("127.0.0.1", srv.server_address[1],
+                                     timeout=5.0)
+            conn.request("POST", "/v1/sample",
+                         body=json.dumps({"data": [[0.5]]}),
+                         headers={"X-Trace-Id": "hdr-1"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            assert resp.getheader("X-Trace-Id") == "hdr-1"
+            conn.close()
+            assert b.trace_ids == ["hdr-1"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestFleetAggregationEndpoints:
+    def test_fleet_scope_merges_workers_and_router(self, spawn_worker):
+        b1, p1 = spawn_worker()
+        b2, p2 = spawn_worker()
+        b1.registry_snapshot["serve_requests_total"]["series"][0][
+            "value"] = 7.0
+        b2.registry_snapshot["serve_requests_total"]["series"][0][
+            "value"] = 5.0
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", f"http://127.0.0.1:{p2}")
+        r.health_pass()
+        snap = r.fleet_metrics_snapshot()
+        assert snap["_fleet"]["gaps"] == []
+        assert sorted(snap["_fleet"]["members"]) == ["router", "w0", "w1"]
+        [series] = snap["serve_requests_total"]["series"]
+        assert series["value"] == 12.0
+        # the router's own registry families ride along
+        assert "fleet_slo_burn_rate" in snap
+
+    def test_failed_worker_scrape_is_a_labeled_gap(self, spawn_worker):
+        b1, p1 = spawn_worker()
+        b1.registry_snapshot = None  # scrape 404s
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p1}")
+        r.add_worker("w1", "http://127.0.0.1:1")  # nothing listens
+        r.health_pass()
+        snap = r.fleet_metrics_snapshot()
+        assert snap["_fleet"]["gaps"] == ["w0", "w1"]
+        up = {s["labels"]["worker"]: s["value"]
+              for s in snap["fleet_member_up"]["series"]}
+        assert up["w0"] == 0.0 and up["w1"] == 0.0 and up["router"] == 1.0
+
+    def test_http_fleet_scope_json_and_prom(self, spawn_worker):
+        import urllib.request
+
+        b, p = spawn_worker()
+        b.registry_snapshot["serve_requests_total"]["series"][0][
+            "value"] = 3.0
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        srv = make_router_server(r, port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            with urllib.request.urlopen(f"{base}/metrics?scope=fleet",
+                                        timeout=5.0) as resp:
+                snap = json.loads(resp.read())
+            assert snap["serve_requests_total"]["series"][0]["value"] == 3.0
+            with urllib.request.urlopen(
+                    f"{base}/metrics?scope=fleet&format=prom",
+                    timeout=5.0) as resp:
+                assert "text/plain" in resp.getheader("Content-Type")
+                text = resp.read().decode()
+            assert 'serve_requests_total{kind="sample",status="ok"} 3' in text
+            assert 'fleet_member_up{worker="w0"} 1' in text
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_debug_trace_merges_router_and_worker_spans(self, spawn_worker):
+        from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
+        b, p = spawn_worker()
+        b.spans = {"traceEvents": [
+            {"name": "serve.request", "ph": "X", "ts": 1.0, "dur": 2.0,
+             "pid": 4242, "tid": 1, "args": {"trace_id": "t-1"}},
+        ]}
+        TRACER.enable()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        status, _ = r.handle("POST", "/v1/sample",
+                             json.dumps({"data": [[0.5]]}).encode(),
+                             trace_id="t-1")
+        assert status == 200
+        merged = r.fleet_trace()
+        names = {e["name"] for e in merged["traceEvents"]}
+        assert "serve.request" in names  # the worker's span
+        assert "fleet.route" in names    # the router's own
+        pids = {e["pid"] for e in merged["traceEvents"]
+                if (e.get("args") or {}).get("trace_id") == "t-1"}
+        assert 4242 in pids and len(pids) >= 2
+        assert merged["metadata"]["gaps"] == []
+
+    def test_debug_trace_tolerates_dead_worker(self, spawn_worker):
+        r = _router()
+        r.add_worker("w0", "http://127.0.0.1:1")
+        merged = r.fleet_trace()
+        assert merged["metadata"]["gaps"] == ["w0"]
+        assert isinstance(merged["traceEvents"], list)
+
+
+class TestSLOAndStalenessSurfaces:
+    def test_healthz_surfaces_slo_and_scrape_age(self, spawn_worker):
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()  # pass 1 admits (probe); pass 2 scrapes /metrics
+        r.health_pass()
+        for _ in range(5):
+            assert _post_sample(r)[0] == 200
+        body = r.healthz()
+        assert body["slo"]["totals"]["requests"] == 5
+        assert body["slo"]["totals"]["failed"] == 0
+        [worker] = body["workers"]
+        assert isinstance(worker["last_scrape_age_s"], float)
+        assert worker["last_scrape_age_s"] >= 0.0
+
+    def test_scrape_age_absent_before_first_scrape(self, spawn_worker):
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        [worker] = [w.snapshot() for w in r.workers()]
+        assert worker["last_scrape_age_s"] is None
+
+    def test_brownout_burns_availability(self, spawn_worker):
+        from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig
+
+        r = _router(slo_config=SLOConfig(availability_target=0.9,
+                                         fast_window_s=30.0,
+                                         slow_window_s=60.0))
+        # no workers registered: every request is an honest 503
+        for _ in range(10):
+            status, _ = _post_sample(r)
+            assert status == 503
+        slo = r.healthz()["slo"]
+        assert slo["ok"] is False
+        assert slo["burn_rates"]["availability"]["fast"] == pytest.approx(
+            1.0 / (1.0 - 0.9))
+        assert slo["totals"] == {"requests": 10, "failed": 10, "slow": 0}
+
+
+class TestManagerTelemetryFlag:
+    def test_worker_cmd_carries_telemetry(self, tmp_path):
+        r = _router()
+        m = FleetManager(r, str(tmp_path), num_workers=1, ports=[1],
+                         spawn=lambda slot, bundle: None, telemetry=True)
+        cmd = m._worker_cmd(m.slots[0], "/bundle")
+        assert "--telemetry" in cmd
+        m2_router = _router()
+        m2 = FleetManager(m2_router, str(tmp_path), num_workers=1, ports=[2],
+                          spawn=lambda slot, bundle: None)
+        assert "--telemetry" not in m2._worker_cmd(m2.slots[0], "/bundle")
+
+
+class TestReviewHardening:
+    def test_fleet_json_is_strict_json_with_empty_slo_windows(
+            self, spawn_worker):
+        # an idle router's SLO gauges hold NaN (empty windows, fails
+        # closed) — the JSON fleet surface must carry null, not a NaN
+        # token strict parsers reject
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        srv = make_router_server(r, port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.server_address[1]}"
+                    f"/metrics?scope=fleet", timeout=5.0) as resp:
+                text = resp.read().decode()
+            # parse with NaN acceptance DISABLED — the strict-parser view
+            body = json.loads(
+                text, parse_constant=lambda c: pytest.fail(
+                    f"non-JSON constant {c!r} in fleet payload"))
+            burn = {
+                (s["labels"]["objective"], s["labels"]["window"]):
+                    s["value"]
+                for s in body["fleet_slo_burn_rate"]["series"]
+            }
+            assert burn[("availability", "fast")] is None
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_route_exception_records_slo_failure(self, monkeypatch):
+        r = _router()
+
+        def boom(method, path, body):
+            raise RuntimeError("router bug")
+
+        monkeypatch.setattr(r, "_route", boom)
+        with pytest.raises(RuntimeError):
+            r.handle("POST", "/v1/sample", b"{}")
+        slo = r.slo.snapshot()
+        assert slo["totals"] == {"requests": 1, "failed": 1, "slow": 0}
+
+    def test_fleet_snapshot_with_no_workers(self):
+        r = _router()
+        snap = r.fleet_metrics_snapshot()
+        assert snap["_fleet"]["members"] == ["router"]
+        assert snap["_fleet"]["gaps"] == []

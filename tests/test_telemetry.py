@@ -741,3 +741,406 @@ class TestEndToEndTrace:
             capture_output=True, text=True, cwd=REPO, timeout=120)
         assert proc.returncode == 0, proc.stderr
         assert "resilience.publish" in proc.stdout
+
+
+# ===========================================================================
+# fleet aggregation: merge semantics (ISSUE-11)
+# ===========================================================================
+
+class TestFleetAggregate:
+    def _snap(self, build):
+        reg = MetricsRegistry()
+        build(reg)
+        return reg.snapshot(include_samples=True)
+
+    def test_counters_sum_exactly(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        def w0(reg):
+            fam = reg.counter("serve_requests_total", "x",
+                              labelnames=("kind", "status"))
+            fam.labels(kind="sample", status="ok").inc(7)
+            fam.labels(kind="classify", status="ok").inc(2)
+
+        def w1(reg):
+            fam = reg.counter("serve_requests_total", "x",
+                              labelnames=("kind", "status"))
+            fam.labels(kind="sample", status="ok").inc(5)
+            fam.labels(kind="sample", status="error").inc(1)
+
+        merged = merge_snapshots({"w0": self._snap(w0), "w1": self._snap(w1)})
+        series = {
+            tuple(sorted(s["labels"].items())): s["value"]
+            for s in merged["serve_requests_total"]["series"]
+        }
+        assert series[(("kind", "sample"), ("status", "ok"))] == 12
+        assert series[(("kind", "classify"), ("status", "ok"))] == 2
+        assert series[(("kind", "sample"), ("status", "error"))] == 1
+
+    def test_counter_exactness_under_concurrent_scrapes(self):
+        """The merge math loses nothing: while N threads hammer two live
+        registries, every (scrape both → merge) sample equals the sum of
+        the two per-registry scraped values EXACTLY — aggregation is
+        arithmetic over atomic snapshots, not estimation."""
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        regs = [MetricsRegistry(), MetricsRegistry()]
+        counters = [r.counter("c", "x").labels() for r in regs]
+        stop = threading.Event()
+
+        def hammer(c):
+            while not stop.is_set():
+                c.inc()
+
+        threads = [threading.Thread(target=hammer, args=(c,), daemon=True)
+                   for c in counters for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(50):
+                snaps = [r.snapshot(include_samples=True) for r in regs]
+                expected = sum(
+                    s["c"]["series"][0]["value"] for s in snaps)
+                merged = merge_snapshots({"a": snaps[0], "b": snaps[1]})
+                assert merged["c"]["series"][0]["value"] == expected
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+
+    def test_gauges_labeled_per_worker_not_summed(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        def w(depth):
+            def build(reg):
+                reg.gauge("serve_queue_depth", "x").set(depth)
+            return build
+
+        merged = merge_snapshots({"w0": self._snap(w(3)),
+                                  "w1": self._snap(w(0))})
+        series = {s["labels"]["worker"]: s["value"]
+                  for s in merged["serve_queue_depth"]["series"]}
+        assert series == {"w0": 3.0, "w1": 0.0}
+
+    def test_histogram_percentile_parity_vs_single_stream(self):
+        """The acceptance property: percentiles of the merged histogram
+        equal percentiles of one histogram that observed ALL the values —
+        the nearest-rank contract holds fleet-wide because the merge
+        pools raw samples instead of averaging quantiles."""
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        rng = np.random.default_rng(11)
+        values = rng.exponential(0.05, size=301)
+        split = 117
+
+        def member(chunk):
+            def build(reg):
+                h = reg.histogram("lat", "x").labels()
+                for v in chunk:
+                    h.observe(float(v))
+            return build
+
+        merged = merge_snapshots({
+            "w0": self._snap(member(values[:split])),
+            "w1": self._snap(member(values[split:])),
+        })
+        got = merged["lat"]["series"][0]
+        want = percentiles([float(v) for v in values])
+        assert got["count"] == len(values)
+        assert got["sum"] == pytest.approx(float(values.sum()))
+        for key in ("p50", "p95", "p99"):
+            assert got[key] == want[key]
+
+    def test_partial_fleet_scrape_degrades_to_labeled_gap(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import (
+            merge_snapshots,
+        )
+
+        def w(reg):
+            reg.counter("c", "x").inc(4)
+
+        merged = merge_snapshots({"w0": self._snap(w)}, gaps=["w1", "w2"])
+        up = {s["labels"]["worker"]: s["value"]
+              for s in merged["fleet_member_up"]["series"]}
+        assert up == {"w0": 1.0, "w1": 0.0, "w2": 0.0}
+        assert merged["_fleet"]["gaps"] == ["w1", "w2"]
+        assert merged["c"]["series"][0]["value"] == 4
+
+    def test_malformed_member_never_crashes(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        def w(reg):
+            reg.counter("c", "x").inc(1)
+
+        merged = merge_snapshots({
+            "good": self._snap(w),
+            "junk": ["not", "a", "snapshot"],
+            "halfjunk": {"c": {"type": "gauge",
+                               "series": [{"labels": {}, "value": 9}]},
+                         "bad": 42},
+        })
+        # good's counter survives; junk members land in conflicts
+        assert merged["c"]["series"][0]["value"] == 1
+        conflicts = "\n".join(merged["_fleet"]["conflicts"])
+        assert "junk" in conflicts and "halfjunk" in conflicts
+
+    def test_prometheus_rendering_of_merged_snapshot(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import (
+            merge_snapshots,
+            snapshot_to_prometheus,
+        )
+
+        def w0(reg):
+            reg.counter("fleet_c", "help text").inc(3)
+            reg.histogram("lat", "l").labels().observe(0.25)
+
+        def w1(reg):
+            reg.counter("fleet_c", "help text").inc(4)
+
+        text = snapshot_to_prometheus(merge_snapshots(
+            {"w0": self._snap(w0), "w1": self._snap(w1)}, gaps=["w2"]))
+        assert "# TYPE fleet_c counter" in text
+        assert "fleet_c 7" in text
+        assert 'lat{quantile="0.5"} 0.25' in text
+        assert "lat_count 1" in text
+        assert 'fleet_member_up{worker="w2"} 0' in text
+        assert "_fleet" not in text  # metadata never leaks into exposition
+
+    def test_fmt_handles_nan_and_inf(self):
+        from gan_deeplearning4j_tpu.telemetry.registry import _fmt
+
+        assert _fmt(float("nan")) == "NaN"
+        assert _fmt(float("inf")) == "+Inf"
+        assert _fmt(float("-inf")) == "-Inf"
+        assert _fmt(3.0) == "3"
+
+    def test_registry_snapshot_include_samples(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", "x").labels()
+        h.observe(1.0)
+        h.observe(2.0)
+        assert "samples" not in reg.snapshot()["h"]["series"][0]
+        assert reg.snapshot(include_samples=True)["h"]["series"][0][
+            "samples"] == [1.0, 2.0]
+
+
+# ===========================================================================
+# SLO burn rates (ISSUE-11)
+# ===========================================================================
+
+class TestSLOTracker:
+    def _tracker(self, **kw):
+        from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig, SLOTracker
+
+        clock = {"now": 1000.0}
+        cfg = SLOConfig(availability_target=0.99, latency_threshold_s=0.1,
+                        latency_target=0.9, fast_window_s=10.0,
+                        slow_window_s=100.0, **kw)
+        return SLOTracker(cfg, clock=lambda: clock["now"]), clock
+
+    def test_burn_rate_math(self):
+        tracker, clock = self._tracker()
+        # 100 requests, 2 failures → bad fraction 0.02 against a 0.01
+        # budget → burn 2.0 on both windows
+        for i in range(100):
+            tracker.record(ok=i >= 2, latency_s=0.01)
+        rates = tracker.burn_rates()
+        assert rates["availability"]["fast"] == pytest.approx(2.0)
+        assert rates["availability"]["slow"] == pytest.approx(2.0)
+        assert not tracker.ok()
+
+    def test_latency_objective_excludes_failures(self):
+        tracker, clock = self._tracker()
+        # 10 answered: 1 slow → bad 0.1 against budget 0.1 → burn 1.0;
+        # the 5 failures must not dilute the latency denominator
+        for _ in range(5):
+            tracker.record(ok=False)
+        for i in range(10):
+            tracker.record(ok=True, latency_s=0.5 if i == 0 else 0.01)
+        rates = tracker.burn_rates()
+        assert rates["latency"]["fast"] == pytest.approx(1.0)
+        # availability: 5/15 against 0.01 budget
+        assert rates["availability"]["fast"] == pytest.approx(
+            (5 / 15) / 0.01)
+
+    def test_empty_window_is_nan_and_fails_closed(self):
+        import math as _math
+
+        tracker, clock = self._tracker()
+        rates = tracker.burn_rates()
+        assert _math.isnan(rates["availability"]["fast"])
+        assert _math.isnan(rates["latency"]["slow"])
+        # no data ≠ healthy: the admission signal fails closed
+        assert tracker.ok() is False
+        snap = tracker.snapshot()
+        assert snap["ok"] is False
+        # JSON surface: null, not NaN (healthz payload must stay JSON)
+        assert snap["burn_rates"]["availability"]["fast"] is None
+        assert json.loads(json.dumps(snap, allow_nan=False))["ok"] is False
+
+    def test_multi_window_fast_burn_ages_out(self):
+        tracker, clock = self._tracker()
+        # a burst of failures, then a quiet fast-window: fast recovers,
+        # slow still remembers — the multi-window property
+        for _ in range(20):
+            tracker.record(ok=False)
+        clock["now"] += 50.0  # past fast (10s), inside slow (100s)
+        for _ in range(20):
+            tracker.record(ok=True, latency_s=0.01)
+        rates = tracker.burn_rates()
+        assert rates["availability"]["fast"] == pytest.approx(0.0)
+        assert rates["availability"]["slow"] == pytest.approx(
+            (20 / 40) / 0.01)
+        assert not tracker.ok()  # slow window still burning
+
+    def test_healthy_stream_is_ok(self):
+        tracker, clock = self._tracker()
+        for _ in range(50):
+            tracker.record(ok=True, latency_s=0.01)
+        assert tracker.ok() is True
+        snap = tracker.snapshot()
+        assert snap["ok"] is True
+        assert snap["totals"] == {"requests": 50, "failed": 0, "slow": 0}
+
+    def test_burn_gauges_exported(self):
+        tracker, clock = self._tracker()
+        for _ in range(10):
+            tracker.record(ok=True, latency_s=0.01)
+        tracker.snapshot()
+        snap = get_registry().snapshot()
+        series = {
+            (s["labels"]["objective"], s["labels"]["window"]): s["value"]
+            for s in snap["fleet_slo_burn_rate"]["series"]
+        }
+        assert series[("availability", "fast")] == 0.0
+        assert len(series) == 4
+        ok_series = snap["fleet_slo_ok"]["series"][0]
+        assert ok_series["value"] == 1.0
+
+    def test_events_prune_past_slow_window(self):
+        tracker, clock = self._tracker()
+        for _ in range(10):
+            tracker.record(ok=False)
+        clock["now"] += 200.0  # everything aged out of the slow window
+        tracker.record(ok=True, latency_s=0.01)
+        assert len(tracker._events) == 1
+        rates = tracker.burn_rates()
+        assert rates["availability"]["slow"] == pytest.approx(0.0)
+
+    def test_config_validation(self):
+        from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig
+
+        with pytest.raises(ValueError):
+            SLOConfig(availability_target=1.5).validate()
+        with pytest.raises(ValueError):
+            SLOConfig(fast_window_s=100.0, slow_window_s=10.0).validate()
+        with pytest.raises(ValueError):
+            SLOConfig(latency_threshold_s=0.0).validate()
+
+
+# ===========================================================================
+# trace_report: multi-trace merge + straggler attribution (ISSUE-11)
+# ===========================================================================
+
+class TestTraceReportFleet:
+    def _run(self, *argv):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+             *argv],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+
+    @staticmethod
+    def _trace(path, pid, spans):
+        """Write a synthetic Chrome trace: spans = [(name, ts_us, dur_us,
+        args), ...]."""
+        events = [
+            {"name": name, "ph": "X", "ts": ts, "dur": dur,
+             "pid": pid, "tid": 1, "args": args}
+            for name, ts, dur, args in spans
+        ]
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": events}, fh)
+        return str(path)
+
+    def test_multi_trace_merge_and_worker_tables(self, tmp_path):
+        t0 = self._trace(tmp_path / "w0.json", 100, [
+            ("resilience.step", 0.0, 1000.0, {"step": 0}),
+            ("resilience.step", 2000.0, 1000.0, {"step": 1}),
+        ])
+        t1 = self._trace(tmp_path / "w1.json", 200, [
+            ("resilience.step", 0.0, 3000.0, {"step": 0}),
+            ("resilience.step", 3000.0, 3000.0, {"step": 1}),
+        ])
+        proc = self._run(t0, t1, "--json", str(tmp_path / "r.json"),
+                         "--merge-out", str(tmp_path / "merged.json"))
+        assert proc.returncode == 0, proc.stderr
+        with open(tmp_path / "r.json") as fh:
+            report = json.load(fh)
+        assert report["pids"] == ["100", "200"]
+        # per-pid occupancy: w1 did 3x the busy time
+        assert report["workers"]["100"]["busy_s"] == pytest.approx(2e-3)
+        assert report["workers"]["200"]["busy_s"] == pytest.approx(6e-3)
+        # skew table names the imbalance on the shared span name
+        skew = report["skew"]["resilience.step"]
+        assert skew["skew"] == pytest.approx(3.0)
+        assert "per-worker occupancy" in proc.stdout
+        # the merged artifact is itself a foldable trace
+        assert self._run(str(tmp_path / "merged.json")).returncode == 0
+
+    def test_barrier_attribution_names_the_straggler(self, tmp_path):
+        # worker 1 is the slow shard writer: long stage, no wait;
+        # workers 0/2 stage fast and wait at the publication barrier
+        spans = []
+        for worker, pid, stage_us, wait_us in (
+                (0, 100, 500.0, 4500.0),
+                (1, 200, 5000.0, 100.0),
+                (2, 300, 700.0, 4200.0)):
+            spans.append((worker, pid, stage_us, wait_us))
+        paths = []
+        for worker, pid, stage_us, wait_us in spans:
+            paths.append(self._trace(tmp_path / f"w{worker}.json", pid, [
+                ("resilience.mesh_stage", 0.0, stage_us,
+                 {"gen": 7, "worker": worker}),
+                ("resilience.mesh_commit_wait", stage_us, wait_us,
+                 {"gen": 7, "worker": worker}),
+            ]))
+        proc = self._run(*paths, "--json", str(tmp_path / "r.json"))
+        assert proc.returncode == 0, proc.stderr
+        with open(tmp_path / "r.json") as fh:
+            report = json.load(fh)
+        [barrier] = report["barriers"]
+        assert barrier["generation"] == 7
+        assert barrier["straggler"] == 1
+        assert barrier["straggler_stage_s"] == pytest.approx(5e-3)
+        assert barrier["peer_max_wait_s"] == pytest.approx(4.5e-3)
+        assert "straggler worker 1" in proc.stdout
+
+    def test_single_process_trace_has_no_worker_tables(self, tmp_path):
+        t0 = self._trace(tmp_path / "one.json", 100, [
+            ("serve.request", 0.0, 1000.0, {}),
+        ])
+        proc = self._run(t0, "--json", str(tmp_path / "r.json"))
+        assert proc.returncode == 0, proc.stderr
+        with open(tmp_path / "r.json") as fh:
+            report = json.load(fh)
+        assert "workers" not in report and "barriers" not in report
+
+    def test_async_pairs_do_not_cross_processes(self, tmp_path):
+        # same (name, id) b/e events on two pids: a merged trace must
+        # pair within each pid, never across
+        events = []
+        for pid, t0, t1 in ((100, 0.0, 1000.0), (200, 500.0, 4500.0)):
+            events.append({"name": "serve.flight", "ph": "b", "ts": t0,
+                           "pid": pid, "tid": 1, "id": "f-1"})
+            events.append({"name": "serve.flight", "ph": "e", "ts": t1,
+                           "pid": pid, "tid": 1, "id": "f-1"})
+        path = tmp_path / "pairs.json"
+        path.write_text(json.dumps({"traceEvents": events}))
+        proc = self._run(str(path), "--json", str(tmp_path / "r.json"))
+        assert proc.returncode == 0, proc.stderr
+        with open(tmp_path / "r.json") as fh:
+            report = json.load(fh)
+        assert report["spans"] == 2
+        assert report["workers"]["100"]["busy_s"] == pytest.approx(1e-3)
+        assert report["workers"]["200"]["busy_s"] == pytest.approx(4e-3)
