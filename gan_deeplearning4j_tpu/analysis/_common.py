@@ -56,6 +56,21 @@ SYNC_IO_CALLS = {
     "http.client.HTTPConnection", "http.client.HTTPSConnection",
 }
 
+#: process-spawning entry points — each one launches an OS process. A
+#: supervision loop that reaches one of these with neither an attempt cap
+#: nor a backoff sleep on its failure path is a fork bomb with extra
+#: steps; JG021 flags the loop (the fleet manager's spawn-failure backoff
+#: is the corrected idiom).
+SPAWN_CALLS = {
+    "subprocess.Popen", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "os.fork", "os.posix_spawn", "os.spawnv", "os.spawnl",
+    "multiprocessing.Process",
+}
+
+#: direct backoff-sleep shapes a respawn loop may pace itself with
+SLEEP_CALLS = {"time.sleep"}
+
 
 def build_import_map(tree: ast.AST) -> dict:
     """Local name -> fully-qualified dotted prefix, from import statements.

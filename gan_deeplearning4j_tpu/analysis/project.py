@@ -132,6 +132,7 @@ class FunctionSummary:
     calls: Tuple[str, ...]           # resolved names this function calls
     has_host_callback: bool          # DIRECT io/pure_callback or jax.debug.*
     has_sync_io: bool = False        # DIRECT open/fsync/urlopen/socket...
+    has_spawn: bool = False          # DIRECT subprocess.Popen/run/os.fork...
     node: ast.AST = dataclasses.field(repr=False, default=None)
 
     @property
@@ -171,6 +172,7 @@ class ProjectIndex:
         self.by_path: Dict[str, ModuleInfo] = {}
         self._taint_cache: Dict[str, bool] = {}
         self._io_taint_cache: Dict[str, bool] = {}
+        self._spawn_taint_cache: Dict[str, bool] = {}
         for mod in srcmods:
             self._index_module(mod)
         # second pass: module-level donators that need every summary in place
@@ -236,6 +238,7 @@ class ProjectIndex:
         calls: List[str] = []
         has_cb = False
         has_io = False
+        has_spawn = False
         for n in ast.walk(fn):
             if not isinstance(n, ast.Call):
                 continue
@@ -244,6 +247,8 @@ class ProjectIndex:
                 has_cb = True
             if resolved in _common.SYNC_IO_CALLS:
                 has_io = True
+            if resolved in _common.SPAWN_CALLS:
+                has_spawn = True
             if resolved is None:
                 continue
             calls.append(self._canonical_call(info, resolved))
@@ -262,6 +267,7 @@ class ProjectIndex:
             calls=tuple(dict.fromkeys(calls)),
             has_host_callback=has_cb,
             has_sync_io=has_io,
+            has_spawn=has_spawn,
             node=fn,
         )
         info.functions[summary.qualname] = summary
@@ -395,6 +401,14 @@ class ProjectIndex:
         below a timed step loop is exactly what direct scanning misses."""
         return self._tainted(summary.fq, frozenset(),
                              "has_sync_io", self._io_taint_cache)
+
+    def spawn_tainted(self, summary: FunctionSummary) -> bool:
+        """Same closure, third mark: True when ``summary`` launches an OS
+        process (the :data:`_common.SPAWN_CALLS` set) itself or reaches
+        one through project calls. JG021's input: the relaunch helper a
+        supervision loop calls is where the ``Popen`` actually lives."""
+        return self._tainted(summary.fq, frozenset(),
+                             "has_spawn", self._spawn_taint_cache)
 
     def _tainted(self, fq: str, seen: frozenset, mark: str,
                  cache: Dict[str, bool]) -> bool:

@@ -44,6 +44,9 @@ from gan_deeplearning4j_tpu.analysis.rules.prefetch_callback import (
 from gan_deeplearning4j_tpu.analysis.rules.step_io import (
     SyncHostIoOnStepPath,
 )
+from gan_deeplearning4j_tpu.analysis.rules.respawn import (
+    UnboundedRespawnLoop,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -66,6 +69,7 @@ RULES = [
     ShardedStateSpecMismatch(),
     PrefetchCallbackInTimedRegion(),
     SyncHostIoOnStepPath(),
+    UnboundedRespawnLoop(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -19,17 +19,28 @@ paper (PAPERS.md), applied to the serve side:
   re-admitted), so a SIGKILLed, hung, warming, or draining worker leaves
   and rejoins the pool without operator action;
 - :mod:`.manager` — process lifecycle: spawn from a shared checkpoint
-  store, relaunch on death, force-restart on hang, **draining restarts**
-  (unroute → drain via ``/metrics`` → SIGTERM → relaunch → re-admit
-  warm), and rolling generation upgrades admitted by ONE fleet-level
-  canary decision (sidecar probes + ``deploy.compare_probes``), with
-  halt-and-quarantine on regression.
+  store, relaunch on death (spawn failures back off, never a hot loop),
+  force-restart on hang, **draining restarts** (unroute → drain via
+  ``/metrics`` → SIGTERM → relaunch → re-admit warm), and rolling
+  generation upgrades admitted by ONE fleet-level canary decision
+  (sidecar probes + ``deploy.compare_probes``), with halt-and-quarantine
+  on regression;
+- :mod:`.autoscaler` — the SLO-driven elastic control loop: resize the
+  fleet between min and max against burn-rate/queue/occupancy signals
+  (hysteresis + cooldowns, fail-closed holds on missing data), scale
+  down only through the drain path, and at max size under sustained
+  overload enter tiered **brownout** admission control at the router
+  instead of falling over.
 
 ``python -m gan_deeplearning4j_tpu.fleet`` runs the whole plane;
 ``scripts/fleet_drill.py`` proves the invariants against real faults.
 Architecture notes: docs/FLEET.md.
 """
 
+from gan_deeplearning4j_tpu.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+)
 from gan_deeplearning4j_tpu.fleet.health import (
     ADMITTABLE,
     CircuitBreaker,
@@ -51,6 +62,8 @@ from gan_deeplearning4j_tpu.fleet.router import (
 
 __all__ = [
     "ADMITTABLE",
+    "Autoscaler",
+    "AutoscalerConfig",
     "CircuitBreaker",
     "FleetManager",
     "FleetRouter",

@@ -90,12 +90,51 @@ def main(argv=None) -> int:
                    help="fast burn-rate window seconds")
     p.add_argument("--slo-slow-window", type=float, default=600.0,
                    help="slow burn-rate window seconds")
+    p.add_argument("--autoscale", action="store_true",
+                   help="enable the SLO-driven elastic control loop: "
+                        "--workers becomes the min/boot size and the "
+                        "fleet resizes up to --max-workers "
+                        "(docs/FLEET.md 'Autoscaling')")
+    p.add_argument("--max-workers", type=int, default=None,
+                   help="autoscaler ceiling (default: 2x --workers); at "
+                        "this size continued overload enters brownout")
+    p.add_argument("--scale-up-pressure", type=float, default=3.0,
+                   help="queue+in-flight per routable worker at/above "
+                        "which a tick counts toward scale-up")
+    p.add_argument("--scale-down-pressure", type=float, default=1.0,
+                   help="pressure at/below which a tick counts toward "
+                        "scale-down (must be < --scale-up-pressure)")
+    p.add_argument("--scale-up-ticks", type=int, default=3,
+                   help="consecutive overloaded ticks before scaling up")
+    p.add_argument("--scale-down-ticks", type=int, default=10,
+                   help="consecutive calm ticks before scaling down")
+    p.add_argument("--scale-interval", type=float, default=1.0,
+                   help="autoscaler decision tick seconds")
+    p.add_argument("--scale-up-cooldown", type=float, default=5.0,
+                   help="seconds after a scale-up before the next resize")
+    p.add_argument("--scale-down-cooldown", type=float, default=15.0,
+                   help="seconds after a scale-down before the next resize")
+    p.add_argument("--brownout-max-rows", type=int, default=32,
+                   help="tier-1 brownout: /v1/sample slabs with more rows "
+                        "are shed with an honest 503")
+    p.add_argument("--brownout-deadline-ms", type=float, default=1000.0,
+                   help="tier-2 brownout: effective per-request deadline "
+                        "cap injected at the router")
+    p.add_argument("--brownout-exit-ticks", type=int, default=5,
+                   help="consecutive calm ticks before a brownout tier "
+                        "releases")
+    p.add_argument("--spawn-backoff", type=float, default=0.5,
+                   help="base seconds for the capped exponential backoff "
+                        "on workers that die before becoming routable")
+    p.add_argument("--spawn-backoff-max", type=float, default=30.0,
+                   help="backoff cap for repeated spawn failures")
     args = p.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
     from gan_deeplearning4j_tpu.deploy import CanaryThresholds
+    from gan_deeplearning4j_tpu.fleet.autoscaler import AutoscalerConfig
     from gan_deeplearning4j_tpu.fleet.manager import FleetManager
     from gan_deeplearning4j_tpu.fleet.router import (
         FleetRouter,
@@ -140,6 +179,22 @@ def main(argv=None) -> int:
             slow_window_s=args.slo_slow_window,
         ),
     )
+    autoscale = None
+    if args.autoscale:
+        autoscale = AutoscalerConfig(
+            min_workers=args.workers,
+            max_workers=args.max_workers or 2 * args.workers,
+            up_pressure=args.scale_up_pressure,
+            down_pressure=args.scale_down_pressure,
+            up_consecutive=args.scale_up_ticks,
+            down_consecutive=args.scale_down_ticks,
+            interval_s=args.scale_interval,
+            up_cooldown_s=args.scale_up_cooldown,
+            down_cooldown_s=args.scale_down_cooldown,
+            brownout_exit_ticks=args.brownout_exit_ticks,
+            brownout_max_rows=args.brownout_max_rows,
+            brownout_deadline_s=args.brownout_deadline_ms / 1e3,
+        )
     manager = FleetManager(
         router, args.store,
         num_workers=args.workers, ports=ports, host=args.host,
@@ -158,6 +213,9 @@ def main(argv=None) -> int:
             accuracy_drop_max=args.canary_acc_drop,
         ),
         telemetry=args.telemetry,
+        autoscale=autoscale,
+        spawn_backoff_base=args.spawn_backoff,
+        spawn_backoff_max=args.spawn_backoff_max,
     )
     log = logging.getLogger(__name__)
     # bind the router port BEFORE spawning workers: a bind failure must

@@ -10,7 +10,19 @@ The manager owns what the router must not: processes and model versions.
   after ``hang_restart_after`` — long enough that a transient stall gets
   its half-open re-admission chance first. A live worker that never
   reaches its FIRST admission (hung mid-warmup, where init probe failures
-  cannot trip the breaker) is force-restarted after ``warm_timeout``.
+  cannot trip the breaker) is force-restarted after ``warm_timeout``; a
+  worker that DIES before its first admission relaunches on a capped
+  exponential backoff (``spawn_backoff_base``/``spawn_backoff_max``,
+  ``fleet_spawn_failures_total``) — a bundle that kills every boot must
+  not turn supervision into a fork loop (jaxlint JG021).
+- **elastic resize** — with an :class:`~.autoscaler.AutoscalerConfig`
+  the supervise loop ticks the SLO-driven control loop
+  (fleet/autoscaler.py): :meth:`FleetManager.scale_up_one` spawns a new
+  slot from the current bundle (it re-earns admission before counting
+  as capacity), :meth:`FleetManager.scale_down_one` retires the
+  least-loaded routable worker through the drain path. Resizes take the
+  same cycle lock as rolling upgrades — they queue behind a roll,
+  never interleave with it.
 - **draining restart** — the zero-lost worker rotation (docs/FLEET.md):
   mark draining at the router (no new requests), ``POST /admin/drain`` on
   the worker (its ``/healthz`` leaves the admittable set), watch its
@@ -116,6 +128,12 @@ class WorkerSlot:
         self.restarts = 0
         self.open_since: Optional[float] = None  # breaker-open watermark
         self.launched_at: Optional[float] = None  # init-hang watermark
+        # spawn-failure backoff state: a process that dies before EVER
+        # earning router admission relaunches on a capped exponential
+        # schedule, not in a tight loop (docs/FLEET.md)
+        self.ever_routable = False
+        self.spawn_failures = 0
+        self.next_launch_at: Optional[float] = None
 
 
 class FleetManager:
@@ -143,9 +161,15 @@ class FleetManager:
                  thresholds: Optional[CanaryThresholds] = None,
                  probe_timeout_s: float = 600.0, probe_retries: int = 3,
                  spawn=None, env: Optional[dict] = None,
-                 telemetry: bool = False):
+                 telemetry: bool = False,
+                 autoscale=None,
+                 spawn_backoff_base: float = 0.5,
+                 spawn_backoff_max: float = 30.0):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
+        if spawn_backoff_base <= 0 or spawn_backoff_max < spawn_backoff_base:
+            raise ValueError("need 0 < spawn_backoff_base <= "
+                             "spawn_backoff_max")
         from gan_deeplearning4j_tpu.resilience.store import CheckpointStore
 
         self.router = router
@@ -171,10 +195,15 @@ class FleetManager:
         self.telemetry = telemetry
         self._spawn = spawn or self._spawn_process
         self._env = env
+        self.spawn_backoff_base = spawn_backoff_base
+        self.spawn_backoff_max = spawn_backoff_max
         if ports is None:
             ports = [_free_port(host) for _ in range(num_workers)]
         self.slots = [WorkerSlot(f"w{i}", p, host)
                       for i, p in enumerate(ports)]
+        # monotonic id allocator: a scaled-down slot's id is never reused
+        # (its counters, logs, and events stay unambiguous)
+        self._next_slot_idx = len(self.slots)
         self.generation: Optional[int] = None
         self.bundle_path: Optional[str] = None
         # dis-feature probes are pinned to ONE classifier for the fleet's
@@ -224,6 +253,23 @@ class FleetManager:
         self._g_generation = registry.gauge(
             "fleet_generation",
             "store generation the fleet is converged on (-1 = mid-roll)")
+        self._c_spawn_failures = registry.counter(
+            "fleet_spawn_failures_total",
+            "worker processes that died before ever becoming routable "
+            "(each schedules a backed-off relaunch, never a hot loop)")
+        # the SLO-driven elastic control loop (fleet/autoscaler.py):
+        # ticked by the supervise loop, resizes through scale_up_one /
+        # scale_down_one under the same cycle lock rolling upgrades hold
+        self.autoscaler = None
+        if autoscale is not None:
+            from gan_deeplearning4j_tpu.fleet.autoscaler import Autoscaler
+
+            if not (autoscale.min_workers <= num_workers
+                    <= autoscale.max_workers):
+                raise ValueError(
+                    f"num_workers={num_workers} outside the autoscaler's "
+                    f"{autoscale.min_workers}..{autoscale.max_workers}")
+            self.autoscaler = Autoscaler(self, autoscale)
         router.manager = self
 
     # -- lifecycle -------------------------------------------------------
@@ -262,13 +308,13 @@ class FleetManager:
         if t is not None:
             t.join(timeout)
         self.router.stop()
-        for slot in self.slots:
+        for slot in list(self.slots):
             if slot.process is not None:
                 slot.process.stop()
 
     def status(self) -> dict:
         with self._lock:
-            return {
+            body = {
                 "state": self._state,
                 "generation": self.generation,
                 "rolls": self._rolls,
@@ -282,11 +328,15 @@ class FleetManager:
                         "alive": (s.process is not None
                                   and s.process.alive()),
                         "restarts": s.restarts,
+                        "spawn_failures": s.spawn_failures,
                         "bundle": s.bundle_path,
                     }
                     for s in self.slots
                 ],
             }
+        if self.autoscaler is not None:
+            body["autoscaler"] = self.autoscaler.status()
+        return body
 
     def poll_now(self, wait: bool = True) -> dict:
         """Force a store poll (POST /admin/poll on the router). With
@@ -325,6 +375,10 @@ class FleetManager:
         slot.bundle_path = bundle_path
         slot.open_since = None
         slot.launched_at = time.monotonic()
+        # the NEW process has not earned admission yet: if it dies before
+        # it does, the relaunch goes through the spawn-failure backoff
+        slot.ever_routable = False
+        slot.next_launch_at = None
         try:
             ref = self.router.worker(slot.id)
         except KeyError:
@@ -410,6 +464,69 @@ class FleetManager:
             with self._lock:
                 self._busy_slots.discard(slot.id)
 
+    # -- elastic resize (fleet/autoscaler.py drives these) ----------------
+    def scale_up_one(self):
+        """Add one worker slot spawned from the fleet's current bundle.
+        The new worker re-earns router admission through the normal
+        init-probe path before it ever counts as capacity; a boot that
+        wedges is bounded by ``warm_timeout`` supervision and a boot
+        that dies goes through the spawn-failure backoff. Returns the
+        new slot, or None when there is no bundle to spawn from."""
+        if self._stop.is_set() or self.bundle_path is None:
+            return None
+        with self._lock:
+            idx = self._next_slot_idx
+            self._next_slot_idx += 1
+        slot = WorkerSlot(f"w{idx}", _free_port(self.host), self.host)
+        self._launch(slot, self.bundle_path)
+        with self._lock:
+            self.slots.append(slot)
+            self.events.append({"event": "scale_up", "worker": slot.id,
+                                "workers": len(self.slots)})
+        logger.info("scale-up: spawned worker %s on port %d (%d slots)",
+                    slot.id, slot.port, len(self.slots))
+        return slot
+
+    def scale_down_one(self) -> bool:
+        """Retire the LEAST-LOADED routable worker through the drain
+        path: unroute -> POST /admin/drain -> bounded drain watch ->
+        SIGTERM -> remove from router and slot list. Never drops an
+        in-flight request (a drain that times out forces through, the
+        same bounded-beats-graceful trade a rotation makes). False when
+        no routable worker exists to retire."""
+        candidates = []
+        for slot in list(self.slots):
+            try:
+                ref = self.router.worker(slot.id)
+            except KeyError:
+                continue
+            if ref.routable:
+                candidates.append((ref.load, slot))
+        if not candidates:
+            return False  # nothing safely retirable — hold instead
+        _, slot = min(candidates, key=lambda pair: pair[0])
+        with self._lock:
+            self._busy_slots.add(slot.id)
+        try:
+            with TRACER.span("fleet.retire", worker=slot.id):
+                drained = self.drain_worker(slot)
+                if slot.process is not None:
+                    slot.process.stop()
+                self.router.remove_worker(slot.id)
+                with self._lock:
+                    if slot in self.slots:
+                        self.slots.remove(slot)
+                    self.events.append({"event": "scale_down",
+                                        "worker": slot.id,
+                                        "drained": drained,
+                                        "workers": len(self.slots)})
+        finally:
+            with self._lock:
+                self._busy_slots.discard(slot.id)
+        logger.info("scale-down: retired worker %s (drained=%s, %d slots)",
+                    slot.id, drained, len(self.slots))
+        return True
+
     # -- the supervise loop ----------------------------------------------
     def _loop(self) -> None:
         next_poll = time.monotonic()
@@ -420,6 +537,11 @@ class FleetManager:
                 # a crashed worker elsewhere in the fleet. The slot being
                 # rotated is skipped via _rotating instead.
                 self._supervise_once()
+                if self.autoscaler is not None:
+                    # throttled internally; resize actions take
+                    # _cycle_lock non-blocking so a roll in flight defers
+                    # the resize instead of interleaving with it
+                    self.autoscaler.tick()
                 if time.monotonic() >= next_poll:
                     next_poll = time.monotonic() + self.poll_interval
                     with self._cycle_lock:
@@ -456,16 +578,43 @@ class FleetManager:
         now = time.monotonic()
         with self._lock:
             busy = set(self._busy_slots)
-        for slot in self.slots:
+            slots = list(self.slots)  # the autoscaler resizes this list
+        for slot in slots:
             if slot.id in busy:
                 continue  # a rotation/rollback owns this slot's process
             if slot.process is not None and not slot.process.alive():
+                rc = getattr(getattr(slot.process, "proc", None),
+                             "returncode", None)
+                if not slot.ever_routable:
+                    # died before EVER earning admission: a bundle or
+                    # environment that kills every boot would otherwise
+                    # relaunch in a tight loop. Capped exponential
+                    # backoff per consecutive failure; the counter makes
+                    # the loop's absence observable.
+                    if slot.next_launch_at is None:
+                        slot.spawn_failures += 1
+                        self._c_spawn_failures.inc()
+                        delay = min(self.spawn_backoff_max,
+                                    self.spawn_backoff_base
+                                    * (2 ** (slot.spawn_failures - 1)))
+                        slot.next_launch_at = now + delay
+                        with self._lock:
+                            self.events.append({
+                                "event": "spawn_failure",
+                                "worker": slot.id,
+                                "failures": slot.spawn_failures,
+                                "retry_in_s": round(delay, 3)})
+                        logger.warning(
+                            "worker %s died before becoming routable "
+                            "(rc=%s, failure %d) — relaunch in %.2fs",
+                            slot.id, rc, slot.spawn_failures, delay)
+                        continue
+                    if now < slot.next_launch_at:
+                        continue  # still backing off
                 # SIGKILL/crash: relaunch with the bundle this slot was
                 # last launched on (mid-roll, an already-rotated slot must
                 # come back on the candidate, not the fleet's pre-roll
                 # bundle — a halted roll rolls it back by bundle_path)
-                rc = getattr(getattr(slot.process, "proc", None),
-                             "returncode", None)
                 self._restart(slot, slot.bundle_path or self.bundle_path,
                               f"process died (rc={rc})")
                 continue
@@ -500,6 +649,12 @@ class FleetManager:
                                   f"{self.warm_timeout:.0f}s of launch")
             else:
                 slot.open_since = None
+                if state == "closed":
+                    # admission earned: this process is no longer a spawn
+                    # failure candidate, and the backoff ladder resets
+                    slot.ever_routable = True
+                    slot.spawn_failures = 0
+                    slot.next_launch_at = None
 
     # -- rolling upgrades -------------------------------------------------
     def _poll_cycle(self) -> bool:

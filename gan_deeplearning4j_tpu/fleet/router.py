@@ -20,6 +20,12 @@ cannot (docs/FLEET.md):
   ``/healthz`` actively (admission, half-open re-admission) and scrapes
   ``/metrics`` (load + liveness). A SIGKILLed, hung, warming, or
   draining worker silently leaves the pool and rejoins when healthy.
+- **brownout admission control** — at the autoscaler's max size under
+  sustained overload (docs/FLEET.md "Brownout") the router degrades in
+  a chosen order instead of collapsing: tier 1 sheds oversized
+  ``sample`` slabs with an honest 503, tier 2 additionally caps
+  effective deadlines; the state is explicit in ``/healthz``
+  (``"brownout"``) and the ``fleet_brownout`` gauge.
 
 Exactly-one-answer is the router's contract: every accepted request gets
 exactly one HTTP response — success, the worker's own non-retryable
@@ -243,10 +249,18 @@ class FleetRouter:
         self._health_thread: Optional[threading.Thread] = None
         self.manager = None  # FleetManager, when attached (POST /admin/poll)
         self.started_at = time.time()
+        # brownout: tiered admission control the autoscaler engages when
+        # the fleet is at max size and still overloaded (docs/FLEET.md
+        # "Brownout"). 0 = off, 1 = shed oversized sample slabs, 2 = also
+        # cap effective deadlines.
+        self._brownout_level = 0
+        self._brownout_max_rows = 32
+        self._brownout_deadline_s = 1.0
         # -- counters ----------------------------------------------------
         self._counts = {"proxied": 0, "ok": 0, "error": 0, "retries": 0,
                         "budget_exhausted": 0, "no_worker": 0,
-                        "attempts_exhausted": 0, "ejections": 0}
+                        "attempts_exhausted": 0, "ejections": 0,
+                        "brownout_shed": 0}
         registry = get_registry()
         self._c_requests = registry.counter(
             "fleet_requests_total", "router request outcomes",
@@ -260,6 +274,19 @@ class FleetRouter:
             "fleet_ejections_total", "circuit-breaker trips across workers")
         self._g_routable = registry.gauge(
             "fleet_workers_routable", "workers currently in the routable pool")
+        self._g_brownout = registry.gauge(
+            "fleet_brownout",
+            "brownout tier (0 = off, 1 = large sample slabs shed, "
+            "2 = + effective deadlines capped)")
+        self._g_brownout.set(0.0)
+        self._c_brownout_sheds = registry.counter(
+            "fleet_brownout_sheds_total",
+            "requests shed by brownout admission control",
+            labelnames=("tier",))
+        self._c_brownout_clamps = registry.counter(
+            "fleet_brownout_deadline_clamps_total",
+            "admitted requests whose effective deadline was capped by "
+            "tier-2 brownout")
         # SLO burn-rate tracking over every routed outcome — the healthz
         # block and the admission signal (telemetry/slo.py)
         self.slo = SLOTracker(slo_config)
@@ -302,6 +329,76 @@ class FleetRouter:
         with self._lock:  # Random() is not thread-safe
             a, b = self._rng.sample(candidates, 2)
         return a if a.load <= b.load else b
+
+    # -- brownout admission control --------------------------------------
+    @property
+    def brownout_level(self) -> int:
+        with self._lock:
+            return self._brownout_level
+
+    def set_brownout(self, level: int, max_rows: Optional[int] = None,
+                     deadline_s: Optional[float] = None) -> None:
+        """Set the brownout tier (clamped to 0..2); ``max_rows`` /
+        ``deadline_s`` override the admission parameters when given.
+        Driven by the autoscaler at max size under sustained overload —
+        degradation becomes ordered and observable (``/healthz``
+        ``brownout`` block, ``fleet_brownout`` gauge) instead of
+        emergent queue collapse."""
+        level = max(0, min(2, int(level)))
+        with self._lock:
+            self._brownout_level = level
+            if max_rows is not None:
+                self._brownout_max_rows = int(max_rows)
+            if deadline_s is not None:
+                self._brownout_deadline_s = float(deadline_s)
+        self._g_brownout.set(float(level))
+        logger.warning("brownout tier set to %d", level)
+
+    def _brownout_admit(self, path: str, body: Optional[bytes]
+                        ) -> Tuple[Optional[bytes], Optional[bytes]]:
+        """Tiered admission under brownout: ``(body, shed)``. A non-None
+        ``shed`` is the 503 payload for a tier-1 rejection (oversized
+        ``sample`` slab — the largest single cost one request can
+        impose); otherwise ``body`` may come back rewritten with a
+        tier-2 effective-deadline cap. Malformed bodies pass through
+        untouched — the worker's 400 is the client's answer, not ours."""
+        with self._lock:
+            level = self._brownout_level
+            max_rows = self._brownout_max_rows
+            deadline = self._brownout_deadline_s
+        if level < 1 or body is None or not path.startswith("/v1/"):
+            return body, None
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return body, None
+        if not isinstance(payload, dict):
+            return body, None
+        data = payload.get("data")
+        # row counting mirrors the worker's shape rules: a flat 1-D list
+        # is ONE row (service.py reshapes it), not len(data) rows — a
+        # single wide sample must never be shed as a slab
+        if not isinstance(data, list) or not data:
+            rows = 0
+        elif isinstance(data[0], (list, tuple)):
+            rows = len(data)
+        else:
+            rows = 1
+        if path.startswith("/v1/sample") and rows > max_rows:
+            self._c_brownout_sheds.labels(tier="large_slab").inc()
+            return body, _json_body(
+                "overloaded",
+                f"brownout: sample slabs over {max_rows} rows are shed "
+                f"until the fleet recovers (got {rows})")
+        if level >= 2:
+            timeout = payload.get("timeout")
+            if timeout is not None and not isinstance(timeout, (int, float)):
+                return body, None  # let the worker reject it with a 400
+            if timeout is None or timeout > deadline:
+                payload["timeout"] = deadline
+                self._c_brownout_clamps.inc()
+                body = json.dumps(payload).encode()
+        return body, None
 
     # -- the proxy -------------------------------------------------------
     def _attempt(self, ref: WorkerRef, method: str, path: str,
@@ -365,6 +462,15 @@ class FleetRouter:
         self.budget.deposit()
         with self._lock:
             self._counts["proxied"] += 1
+        body, shed = self._brownout_admit(path, body)
+        if shed is not None:
+            # an ordered, honest 503 — observable in the counters and in
+            # the SLO burn (handle() records every 5xx), never a retry
+            with self._lock:
+                self._counts["brownout_shed"] += 1
+                self._counts["error"] += 1
+            self._c_requests.labels(outcome="brownout_shed").inc()
+            return 503, shed
         tried: set = set()
         retryable: Optional[str] = None
         for attempt in range(self.max_attempts):
@@ -557,9 +663,19 @@ class FleetRouter:
         routable = [w for w in workers if w["routable"]]
         generations = sorted({w["generation"] for w in routable
                               if w["generation"] is not None})
-        status = ("ok" if routable else "down")
+        with self._lock:
+            level = self._brownout_level
+            max_rows = self._brownout_max_rows
+            deadline = self._brownout_deadline_s
+        # "brownout" outranks "ok": the fleet is serving, but degraded —
+        # by design, not by accident — and a dashboard must say so
+        status = ("down" if not routable
+                  else "brownout" if level > 0 else "ok")
         body = {
             "status": status,
+            "brownout": {"active": level > 0, "level": level,
+                         "max_sample_rows": max_rows,
+                         "deadline_cap_s": deadline},
             "role": "router",
             "workers": workers,
             "routable": len(routable),
@@ -579,9 +695,11 @@ class FleetRouter:
     def metrics(self) -> dict:
         with self._lock:
             counts = dict(self._counts)
+            level = self._brownout_level
         return {
             **counts,
             "retry_budget_tokens": self.budget.tokens,
+            "brownout_level": level,
             "slo": self.slo.snapshot(),
             "workers": [w.snapshot() for w in self.workers()],
         }

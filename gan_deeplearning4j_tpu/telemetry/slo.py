@@ -91,6 +91,9 @@ class SLOTracker:
         # (excluded from the latency SLI, see module docstring)
         self._events: deque = deque(maxlen=self.config.max_events)
         self._total = {"requests": 0, "failed": 0, "slow": 0}
+        # high-water mark of observed clock readings: event timestamps
+        # are clamped monotonic against it (see _now_locked)
+        self._clock_hwm: Optional[float] = None
         registry = get_registry()
         self._g_burn = registry.gauge(
             "fleet_slo_burn_rate",
@@ -103,15 +106,33 @@ class SLOTracker:
             "1.0, 0 otherwise (NaN burn = 0 — no data fails closed)")
 
     # -- recording -------------------------------------------------------
+    def _now_locked(self) -> float:
+        """The clock reading, clamped monotonic (caller holds the lock).
+        The default clock is ``time.monotonic``, but the tracker is
+        clock-injectable and deployments substitute wall clocks — which
+        STEP: NTP slews, VM suspend/resume, leap smears. A backwards
+        step would write an out-of-order timestamp into the event deque,
+        silently skewing window membership (the prune loop stops at the
+        first in-window event, so misordered old events survive behind
+        it, and a window evaluated at the stepped-back "now" ages events
+        it should still hold). Clamping to the high-water mark keeps the
+        deque sorted and every window evaluation consistent; when the
+        clock recovers past the mark, real time resumes."""
+        now = self._clock()
+        if self._clock_hwm is not None and now < self._clock_hwm:
+            return self._clock_hwm
+        self._clock_hwm = now
+        return now
+
     def record(self, ok: bool, latency_s: Optional[float] = None) -> None:
         """One observed outcome. ``ok`` False = availability failure (an
         answered 5xx / honest 503); ``latency_s`` is the client-visible
         duration, measured only for answered (ok) requests."""
-        now = self._clock()
         latency_ok: Optional[bool] = None
         if ok and latency_s is not None:
             latency_ok = latency_s <= self.config.latency_threshold_s
         with self._lock:
+            now = self._now_locked()
             self._events.append((now, bool(ok), latency_ok))
             self._total["requests"] += 1
             if not ok:
@@ -149,9 +170,11 @@ class SLOTracker:
 
     def burn_rates(self) -> dict:
         """``{objective: {window: burn}}`` — NaN for empty windows."""
-        now = self._clock()
         cfg = self.config
         with self._lock:
+            # same monotonic clamp as record(): a stepped-back clock must
+            # not evaluate windows at a "now" older than recorded events
+            now = self._now_locked()
             counts = {
                 "fast": self._window_counts(cfg.fast_window_s, now),
                 "slow": self._window_counts(cfg.slow_window_s, now),

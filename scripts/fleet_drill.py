@@ -89,47 +89,59 @@ def log(msg: str) -> None:
 class LoadGenerator:
     """Closed-loop /v1/sample clients against the ROUTER. Every attempt
     is accounted: ok (200), shed (503), error (other status), or lost
-    (no HTTP answer at all) — the exactly-one-answer ledger. The client
+    (no HTTP answer at all) — the exactly-one-answer ledger — and
+    admitted (200) latencies are captured for the autoscale phase's
+    bounded-p99 invariant. The thread population can be ramped
+    mid-drill (:meth:`add_threads` — the ~10x burst). The client
     timeout leaves room for the router's full retry schedule, so a slow
     answer is never misread as a lost one."""
 
     def __init__(self, base: str, z_size: int, threads: int = 2,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, pace: float = 0.005):
         self.base = base
         self.z_size = z_size
         self.timeout = timeout
         self.stop = threading.Event()
         self.counts = {"sent": 0, "ok": 0, "shed": 0, "error": 0, "lost": 0}
+        self.ok_latencies: list = []
         self._lock = threading.Lock()
-        self._threads = [
-            threading.Thread(target=self._run, args=(i,), daemon=True)
-            for i in range(threads)
-        ]
+        self._threads: list = []
+        self._boot = (threads, pace)
 
-    def _run(self, tid: int) -> None:
+    def _run(self, tid: int, pace: float) -> None:
         rng = np.random.default_rng(2000 + tid)
         while not self.stop.is_set():
             rows = (rng.random((int(rng.integers(1, 4)), self.z_size),
                                dtype=np.float32) * 2.0 - 1.0)
             with self._lock:
                 self.counts["sent"] += 1
+            t0 = time.monotonic()
             status, _ = http_json(
                 "POST", f"{self.base}/v1/sample", {"data": rows.tolist()},
                 timeout=self.timeout)
+            latency = time.monotonic() - t0
             with self._lock:
                 if status is None:
                     self.counts["lost"] += 1
                 elif status == 200:
                     self.counts["ok"] += 1
+                    self.ok_latencies.append(latency)
                 elif status == 503:
                     self.counts["shed"] += 1
                 else:
                     self.counts["error"] += 1
-            time.sleep(0.005)  # keep 2 shared cores breathable
+            time.sleep(pace)  # keep 2 shared cores breathable
 
     def start(self) -> None:
-        for t in self._threads:
+        self.add_threads(*self._boot)
+
+    def add_threads(self, n: int, pace: float = 0.005) -> None:
+        for _ in range(n):
+            t = threading.Thread(
+                target=self._run, args=(len(self._threads), pace),
+                daemon=True)
             t.start()
+            self._threads.append(t)
 
     def finish(self) -> dict:
         self.stop.set()
@@ -335,6 +347,321 @@ def run_aggregation_phase(base: str, worker_ports: list, counts: dict,
     }
 
 
+# ===========================================================================
+# the autoscale-under-burst phase (--autoscale)
+# ===========================================================================
+
+class AutoscaleMonitor:
+    """Polls the router's /healthz, recording the (slot count, brownout)
+    trajectory — the ground truth for 'the fleet grew, brownout engaged
+    only at max, and it shrank back'."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.stop = threading.Event()
+        self.max_slots = 0
+        self.min_slots = 10**9
+        self.brownout_seen = False
+        self.brownout_slot_counts: set = set()
+        self.transitions: list = []  # (slots, brownout_level) changes
+        self.last: dict = {}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        prev = None
+        while not self.stop.is_set():
+            status, body = http_json("GET", f"{self.base}/healthz",
+                                     timeout=5.0)
+            if status == 200 and body:
+                self.last = body
+                slots = len((body.get("fleet") or {}).get("workers", []))
+                brownout = (body.get("brownout") or {})
+                level = int(brownout.get("level") or 0)
+                self.max_slots = max(self.max_slots, slots)
+                self.min_slots = min(self.min_slots, slots)
+                if level > 0:
+                    self.brownout_seen = True
+                    self.brownout_slot_counts.add(slots)
+                if (slots, level) != prev:
+                    prev = (slots, level)
+                    self.transitions.append(
+                        {"t": round(time.monotonic(), 3),
+                         "slots": slots, "brownout": level})
+            time.sleep(0.1)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def finish(self) -> None:
+        self.stop.set()
+        self._thread.join(timeout=10.0)
+
+
+def _p99(samples: list) -> float:
+    if not samples:
+        return float("nan")
+    ranked = sorted(samples)
+    return ranked[min(len(ranked) - 1,
+                      max(0, int(np.ceil(0.99 * len(ranked))) - 1))]
+
+
+def run_autoscale(args) -> int:
+    """The autoscale-under-burst drill (docs/FLEET.md "Autoscaling"):
+    boot an elastic fleet at min size, ramp closed-loop load ~10x, and
+    assert the whole elasticity story — grow to max, mid-resize SIGKILL
+    recovered, brownout only at max, large slabs shed with honest 503s,
+    zero lost, bounded p99 for admitted requests, shrink back to min
+    after quiesce."""
+    min_workers = args.workers or 1
+    max_workers = args.max_workers or 3
+    burst_threads = args.burst_threads or (12 if args.smoke else 16)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_autoscale_")
+    cleanup = args.workdir is None
+    os.makedirs(workdir, exist_ok=True)
+    serve_store = os.path.join(workdir, "store_serve")
+    workload = make_workload(workdir, args.seed)
+    results: dict = {}
+    invariants: dict = {}
+    fleet = None
+    load = monitor = None
+    ok_latencies: list = []
+    router_port = free_port()
+    base = f"http://127.0.0.1:{router_port}"
+    brownout_max_rows = 16
+    z_size = 4  # the drill workload's latent width (make_workload)
+
+    try:
+        # -- phase 0: seed + boot the elastic fleet at min size ----------
+        gen0 = seed_bundle(workload, serve_store, args.keep_last)
+        log(f"seeded serving generation {gen0}")
+        fleet_log = open(os.path.join(workdir, "fleet.log"), "w")
+        fleet = subprocess.Popen(
+            FLEET + [
+                "--store", serve_store,
+                "--workers", str(min_workers),
+                "--port", str(router_port),
+                "--log-dir", workdir,
+                "--poll", "2.0", "--probe-interval", "0.15",
+                "--request-timeout", "3.0",
+                "--retry-ratio", "0.5", "--retry-burst", "10",
+                "--eject-failures", "3", "--reopen-after", "0.5",
+                "--drain-timeout", "15", "--warm-timeout", "240",
+                "--hang-restart", "30",
+                "--buckets", "1,8", "--replicas", "1",
+                "--max-latency", "0.002",
+                "--boot-wait", "60",
+                "--autoscale", "--max-workers", str(max_workers),
+                "--scale-interval", "0.5",
+                "--scale-up-pressure", "3.0", "--scale-down-pressure", "1.0",
+                "--scale-up-ticks", "2", "--scale-down-ticks", "6",
+                "--scale-up-cooldown", "2.0", "--scale-down-cooldown", "2.0",
+                "--brownout-exit-ticks", "4",
+                "--brownout-max-rows", str(brownout_max_rows),
+                "--brownout-deadline-ms", "1500",
+                "--spawn-backoff", "0.5", "--spawn-backoff-max", "5.0",
+                "--slo-fast-window", "5", "--slo-slow-window", "30",
+            ],
+            cwd=_REPO, env=_ENV, stdout=fleet_log, stderr=fleet_log,
+        )
+        health = wait_for(
+            lambda: (fleet.poll() is None
+                     and (h := fleet_health(base)).get("routable")
+                     == min_workers and h.get("generation") == gen0 and h),
+            420.0, "fleet healthy at min size")
+        if not health:
+            log(f"fleet never became healthy (rc={fleet.poll()})")
+            return 2
+        initial_ids = {w["id"] for w in (health.get("fleet") or {})
+                       .get("workers", [])}
+        invariants["boots_at_min_size"] = len(initial_ids) == min_workers
+        monitor = AutoscaleMonitor(base)
+        monitor.start()
+
+        # -- phase 1: light load holds at min ----------------------------
+        load = LoadGenerator(base, z_size, threads=0)
+        load.add_threads(1, pace=0.05)
+        time.sleep(6.0)
+        slots_light = len((fleet_health(base).get("fleet") or {})
+                          .get("workers", []))
+        invariants["light_load_holds_at_min"] = slots_light == min_workers
+        log(f"light load: {slots_light} slot(s) (min {min_workers})")
+
+        # -- phase 2: ~10x burst -> scale-up, with a mid-resize SIGKILL --
+        log(f"ramping to {burst_threads + 1} closed-loop threads")
+        load.add_threads(burst_threads, pace=0.002)
+        grown = wait_for(
+            lambda: (len(((h := fleet_health(base)).get("fleet") or {})
+                         .get("workers", [])) > min_workers and h),
+            180.0, "first scale-up under burst")
+        invariants["scales_up_under_burst"] = bool(grown)
+        kill_result: dict = {"killed": None}
+        if grown:
+            # the first scaled-up worker is still warming (jax import +
+            # AOT ladder — tens of seconds): SIGKILL it mid-resize. The
+            # supervise loop must relaunch it (spawn-failure backoff, no
+            # hot loop) and the fleet must still reach max.
+            new_workers = [w for w in (grown.get("fleet") or {})
+                           .get("workers", []) if w["id"] not in initial_ids]
+            victim = new_workers[0]
+            log(f"mid-resize SIGKILL: worker {victim['id']} "
+                f"(pid {victim['pid']})")
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+                kill_result["killed"] = victim
+            except (OSError, TypeError) as exc:
+                log(f"SIGKILL failed ({exc}) — worker finished booting?")
+            recovered = wait_for(
+                lambda: ((w := worker_by_id(fleet_health(base),
+                                            victim["id"])).get("alive")
+                         and w.get("pid") not in (None, victim["pid"])
+                         and w),
+                120.0, "mid-resize-killed worker relaunched")
+            kill_result["recovered"] = recovered or None
+            invariants["mid_resize_sigkill_recovered"] = bool(recovered)
+        results["mid_resize_kill"] = kill_result
+
+        # -- phase 3: brownout at max size -------------------------------
+        browned = wait_for(
+            lambda: ((h := fleet_health(base)).get("brownout") or {})
+                    .get("active") and h,
+            240.0, "brownout under sustained overload at max size")
+        at_brownout = browned or fleet_health(base)
+        slots_at_brownout = len((at_brownout.get("fleet") or {})
+                                .get("workers", []))
+        invariants["brownout_engages"] = bool(browned)
+        invariants["brownout_only_at_max"] = (
+            bool(browned) and slots_at_brownout == max_workers
+            and monitor.brownout_slot_counts <= {max_workers})
+        results["brownout"] = {
+            "slots_at_engage": slots_at_brownout,
+            "healthz_status": (browned or {}).get("status"),
+            "block": (browned or {}).get("brownout"),
+        }
+        # tier-1 admission: an oversized sample slab sheds with an honest
+        # 503 naming the brownout, while the small-slab load keeps flowing
+        big = [[0.0] * z_size for _ in range(brownout_max_rows + 8)]
+        status, body = http_json("POST", f"{base}/v1/sample",
+                                 {"data": big}, timeout=30.0)
+        invariants["brownout_sheds_large_slabs"] = (
+            status == 503 and "brownout" in json.dumps(body or {}))
+        results["brownout"]["large_slab_probe"] = {
+            "status": status, "body": body}
+        _, rm = http_json("GET", f"{base}/metrics", timeout=10.0)
+        results["brownout"]["router_level"] = (rm or {}).get("brownout_level")
+        invariants["brownout_gauge_surfaced"] = (
+            (rm or {}).get("brownout_level", 0) >= 1
+            and (rm or {}).get("brownout_shed", 0) >= 1)
+
+        # every scaled-up worker (the relaunched SIGKILL victim included)
+        # must finish warming and re-earn router admission — "capacity"
+        # means routable, not spawned
+        full = wait_for(
+            lambda: ((h := fleet_health(base)).get("routable")
+                     == max_workers and h),
+            240.0, "scaled-up workers admitted as routable capacity")
+        invariants["scaled_up_workers_admitted"] = bool(full)
+
+        # -- phase 4: quiesce -> drain back to min, brownout released ----
+        counts = load.finish()
+        ok_latencies = list(load.ok_latencies)
+        load = None
+        log("load stopped — waiting for scale-down to min")
+        shrunk = wait_for(
+            lambda: ((h := fleet_health(base)).get("routable") == min_workers
+                     and len((h.get("fleet") or {}).get("workers", []))
+                     == min_workers
+                     and not (h.get("brownout") or {}).get("active") and h),
+            300.0, "fleet drained back to min after quiesce")
+        invariants["quiesce_shrinks_to_min"] = bool(shrunk)
+        monitor.finish()
+
+        # -- phase 5: ledgers --------------------------------------------
+        _, router_metrics = http_json("GET", f"{base}/metrics", timeout=5.0)
+        router_metrics = router_metrics or {}
+        results["requests"] = counts
+        results["router"] = {
+            k: router_metrics.get(k)
+            for k in ("proxied", "ok", "error", "retries",
+                      "budget_exhausted", "no_worker", "attempts_exhausted",
+                      "brownout_shed", "ejections", "retry_budget_tokens")
+        }
+        results["scaling"] = {
+            "max_slots": monitor.max_slots,
+            "min_slots": monitor.min_slots,
+            "transitions": monitor.transitions,
+            "autoscaler": ((shrunk or fleet_health(base)).get("fleet")
+                           or {}).get("autoscaler"),
+        }
+        invariants["exactly_one_answer_zero_lost"] = (
+            counts["lost"] == 0 and counts["error"] == 0
+            and counts["ok"] + counts["shed"] + counts["error"]
+            == counts["sent"])
+        honest_503s = ((router_metrics.get("budget_exhausted") or 0)
+                       + (router_metrics.get("no_worker") or 0)
+                       + (router_metrics.get("attempts_exhausted") or 0)
+                       + (router_metrics.get("brownout_shed") or 0))
+        invariants["sheds_bounded_by_honest_503s"] = (
+            counts["shed"] <= honest_503s)
+    finally:
+        if load is not None:
+            load.finish()
+            ok_latencies = list(load.ok_latencies)
+        if monitor is not None and not monitor.stop.is_set():
+            monitor.finish()
+        if fleet is not None and fleet.poll() is None:
+            fleet.terminate()
+            try:
+                fleet.wait(timeout=20.0)
+            except subprocess.TimeoutExpired:
+                fleet.kill()
+
+    # p99 of admitted requests, bounded: an autoscaling fleet may queue,
+    # but an admitted request must never hang toward its client timeout
+    p99 = _p99(ok_latencies)
+    results["latency"] = {
+        "ok_requests": len(ok_latencies),
+        "p99_s": None if not ok_latencies else round(p99, 4),
+        "bound_s": args.p99_bound,
+    }
+    invariants["p99_of_admitted_bounded"] = (
+        bool(ok_latencies) and p99 <= args.p99_bound)
+
+    ok = bool(invariants) and all(invariants.values())
+    payload = {
+        "bench": "fleet_autoscale_drill",
+        "config": {
+            "min_workers": min_workers,
+            "max_workers": max_workers,
+            "burst_threads": burst_threads,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "results": results,
+        "invariants": invariants,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.output:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(os.path.join(_REPO,
+                               f"BENCH_autoscale_{args.record}.json"),
+                  "w") as fh:
+            fh.write(text + "\n")
+    if cleanup and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        log(f"INVARIANT BREACH — work files kept at {workdir}")
+    for name, good in sorted(invariants.items()):
+        log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
@@ -352,8 +679,27 @@ def main(argv=None) -> int:
                         "tpu_campaign.sh gates trace_report on it")
     p.add_argument("--output", default=None, metavar="PATH")
     p.add_argument("--record", default=None, metavar="TAG",
-                   help="also write BENCH_fleet_<TAG>.json at the repo root")
+                   help="also write BENCH_fleet_<TAG>.json at the repo root "
+                        "(BENCH_autoscale_<TAG>.json with --autoscale)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the autoscale-under-burst phase instead of "
+                        "the fault drill: min-size boot, ~10x closed-loop "
+                        "ramp, grow/brownout/shrink invariants "
+                        "(docs/FLEET.md 'Autoscaling')")
+    p.add_argument("--max-workers", type=int, default=None,
+                   help="autoscale ceiling (default 3; --workers is the "
+                        "min, default 1)")
+    p.add_argument("--burst-threads", type=int, default=None,
+                   help="closed-loop threads in the burst (default 12 "
+                        "smoke / 16 full; the ~10x ramp over the single "
+                        "light-phase thread)")
+    p.add_argument("--p99-bound", type=float, default=15.0,
+                   help="autoscale invariant: p99 seconds bound for "
+                        "admitted (200) requests")
     args = p.parse_args(argv)
+
+    if args.autoscale:
+        return run_autoscale(args)
 
     n_workers = args.workers or (2 if args.smoke else 3)
     total = args.total_steps or (12 if args.smoke else 24)

@@ -118,6 +118,19 @@ if ! timeout 120 python scripts/trace_report.py \
   echo "$(date +%H:%M:%S) fleet trace_report gate failed — campaign aborted (see fleet_trace_report.log)" >> tpu_poller.log
   exit 1
 fi
+# Autoscale smoke (CPU, elastic fleet under a ~10x closed-loop burst):
+# the campaign's artifacts feed a fleet that resizes itself — refuse to
+# start if the elastic story regressed: grow to max with a mid-resize
+# SIGKILL recovered, brownout engaging only at max size, large-slab
+# shedding honest, zero lost, bounded p99, drain back to min after
+# quiesce (enforced by the drill's own exit code). Pinned to CPU so it
+# never touches the chip.
+if ! JAX_PLATFORMS=cpu timeout 1500 python scripts/fleet_drill.py --smoke \
+    --autoscale \
+    --output artifacts/fleet_autoscale_smoke.json > fleet_autoscale_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) fleet autoscale smoke failed — campaign aborted (see fleet_autoscale_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

@@ -2268,6 +2268,155 @@ class TestSyncHostIoOnStepPath:
 
 
 # ===========================================================================
+# JG021 — subprocess respawn loop with no cap and no backoff
+# ===========================================================================
+
+class TestUnboundedRespawnLoop:
+    def test_true_positive_direct_popen_in_supervision_loop(self):
+        # the fleet hazard: a worker that dies on every boot relaunches
+        # as fast as the host can fork — a fork bomb with extra steps
+        r = run(
+            "import subprocess\n"
+            "def supervise(cmd, stop):\n"
+            "    proc = subprocess.Popen(cmd)\n"
+            "    while not stop.is_set():\n"
+            "        if proc.poll() is not None:\n"
+            "            proc = subprocess.Popen(cmd)\n"
+        )
+        assert codes(r) == ["JG021"]
+        assert "backoff" in r.active[0].message
+
+    def test_true_positive_spawn_through_project_helper(self):
+        # the realistic shape: the Popen lives in a relaunch helper, only
+        # the index's spawn-taint closure connects it to the loop
+        r = run(
+            "import subprocess\n"
+            "def relaunch(cmd, log):\n"
+            "    return subprocess.Popen(cmd, stdout=log, stderr=log)\n"
+            "def supervise(cmd, log, stop):\n"
+            "    proc = relaunch(cmd, log)\n"
+            "    while True:\n"
+            "        if proc.poll() is not None:\n"
+            "            proc = relaunch(cmd, log)\n"
+        )
+        assert codes(r) == ["JG021"]
+        assert "relaunch" in r.active[0].message
+
+    def test_true_positive_constructor_spawn(self):
+        # a WorkerProcess-style wrapper class: the spawn sits in __init__
+        r = run(
+            "import subprocess\n"
+            "class Worker:\n"
+            "    def __init__(self, cmd):\n"
+            "        self.proc = subprocess.Popen(cmd)\n"
+            "def supervise(cmd, stop):\n"
+            "    w = Worker(cmd)\n"
+            "    while not stop.is_set():\n"
+            "        if w.proc.poll() is not None:\n"
+            "            w = Worker(cmd)\n"
+        )
+        assert codes(r) == ["JG021"]
+
+    def test_true_positive_argless_popen_wait_is_not_a_pacer(self):
+        # the canonical naive supervisor: p.wait() blocks on the child,
+        # but a child that dies at boot returns it instantly — the loop
+        # forks as fast as the host allows despite "waiting"
+        r = run(
+            "import subprocess\n"
+            "def supervise(cmd):\n"
+            "    while True:\n"
+            "        p = subprocess.Popen(cmd)\n"
+            "        p.wait()\n"
+        )
+        assert codes(r) == ["JG021"]
+
+    def test_true_negative_backoff_sleep_paces_the_loop(self):
+        # the corrected idiom: capped exponential backoff on the respawn
+        r = run(
+            "import subprocess\n"
+            "import time\n"
+            "def supervise(cmd, stop):\n"
+            "    proc = subprocess.Popen(cmd)\n"
+            "    failures = 0\n"
+            "    while not stop.is_set():\n"
+            "        if proc.poll() is not None:\n"
+            "            failures += 1\n"
+            "            time.sleep(min(30.0, 0.5 * 2 ** failures))\n"
+            "            proc = subprocess.Popen(cmd)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_event_wait_paces_the_loop(self):
+        # the manager's supervise-loop shape: stop.wait(interval) is the
+        # pacer even though it is not literally time.sleep
+        r = run(
+            "import subprocess\n"
+            "def supervise(cmd, stop):\n"
+            "    proc = subprocess.Popen(cmd)\n"
+            "    while not stop.is_set():\n"
+            "        if proc.poll() is not None:\n"
+            "            proc = subprocess.Popen(cmd)\n"
+            "        stop.wait(0.2)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_attempt_capped_condition(self):
+        # the resilience drill's relaunch-budget shape: the while
+        # condition IS the attempt cap
+        r = run(
+            "import subprocess\n"
+            "def drill(cmd, budget):\n"
+            "    relaunches = 0\n"
+            "    while relaunches <= budget:\n"
+            "        rc = subprocess.run(cmd).returncode\n"
+            "        if rc == 0:\n"
+            "            break\n"
+            "        relaunches += 1\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_for_loop_is_bounded(self):
+        r = run(
+            "import subprocess\n"
+            "def retry(cmd):\n"
+            "    for _ in range(5):\n"
+            "        if subprocess.run(cmd).returncode == 0:\n"
+            "            break\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_no_spawn_in_loop(self):
+        r = run(
+            "import subprocess\n"
+            "def watch(proc, stop):\n"
+            "    while not stop.is_set():\n"
+            "        if proc.poll() is not None:\n"
+            "            return proc.returncode\n"
+        )
+        assert codes(r) == []
+
+    def test_skips_test_modules(self):
+        r = run(
+            "import subprocess\n"
+            "def test_respawn(cmd, stop):\n"
+            "    while not stop.is_set():\n"
+            "        subprocess.Popen(cmd)\n",
+            path="tests/test_respawn.py",
+        )
+        assert codes(r) == []
+
+    def test_suppression_applies(self):
+        r = run(
+            "import subprocess\n"
+            "def supervise(cmd, stop):\n"
+            "    while not stop.is_set():\n"
+            "        subprocess.Popen(cmd)  # jaxlint: disable=JG021\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG021"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

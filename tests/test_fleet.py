@@ -18,6 +18,8 @@ import numpy as np
 import pytest
 
 from gan_deeplearning4j_tpu.fleet import (
+    Autoscaler,
+    AutoscalerConfig,
     CircuitBreaker,
     FleetManager,
     FleetRouter,
@@ -49,6 +51,7 @@ class _Behavior:
         self.lock = threading.Lock()
         self.hits = 0  # /v1 requests that reached this worker
         self.trace_ids = []  # X-Trace-Id headers seen on /v1 requests
+        self.payloads = []  # parsed /v1 request bodies (brownout rewrites)
         # what GET /metrics?scope=registry answers (the aggregation feed);
         # None = 404, exercising the labeled-gap path
         self.registry_snapshot = {
@@ -97,7 +100,7 @@ class _FakeWorkerHandler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         b = self.behavior
         n = int(self.headers.get("Content-Length") or 0)
-        self.rfile.read(n)
+        raw = self.rfile.read(n)
         if self.path.startswith("/admin/drain"):
             b.draining = True
             self._send(200, {"status": "ok", "draining": True})
@@ -107,6 +110,10 @@ class _FakeWorkerHandler(BaseHTTPRequestHandler):
             tid = self.headers.get("X-Trace-Id")
             if tid:
                 b.trace_ids.append(tid)
+            try:
+                b.payloads.append(json.loads(raw))
+            except ValueError:
+                pass
         if b.mode == "die":
             # the mid-request death shape: the connection drops with no
             # response bytes — the client sees a reset/BadStatusLine
@@ -746,6 +753,12 @@ class TestDrainingRestart:
         slot = mgr.slots[0]
         mgr._launch(slot, "bundle-a")
         mgr.bundle_path = "bundle-a"
+        # the worker earns admission (probe -> closed) and supervision
+        # observes it: a ROUTABLE worker's death relaunches immediately
+        # (the spawn-failure backoff is only for never-admitted boots)
+        r.health_pass()
+        mgr._supervise_once()
+        assert slot.ever_routable
         slot.process._alive = False  # SIGKILL shape
         mgr._supervise_once()
         assert slot.restarts == 1
@@ -796,6 +809,28 @@ class TestFleetDrill:
         assert payload["ok"]
         assert payload["invariants"]["exactly_one_answer_zero_lost"]
         assert payload["invariants"]["poison_never_served"]
+
+    def test_autoscale_drill_smoke(self, tmp_path):
+        # the elasticity story end-to-end against real subprocesses:
+        # ~10x burst -> grow to max (mid-resize SIGKILL recovered) ->
+        # brownout only at max -> quiesce -> drain back to min, with the
+        # zero-lost ledger and bounded p99 held throughout
+        out = tmp_path / "fleet_autoscale.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "fleet_drill.py"),
+             "--smoke", "--autoscale", "--output", str(out),
+             "--workdir", str(tmp_path / "work")],
+            cwd=REPO, capture_output=True, text=True, timeout=1500,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, (
+            f"autoscale drill breached invariants:\n{proc.stdout[-4000:]}\n"
+            f"{proc.stderr[-2000:]}")
+        payload = json.loads(out.read_text())
+        assert payload["ok"]
+        assert payload["invariants"]["exactly_one_answer_zero_lost"]
+        assert payload["invariants"]["brownout_only_at_max"]
+        assert payload["invariants"]["quiesce_shrinks_to_min"]
 
 
 # ===========================================================================
@@ -1086,3 +1121,436 @@ class TestReviewHardening:
         snap = r.fleet_metrics_snapshot()
         assert snap["_fleet"]["members"] == ["router"]
         assert snap["_fleet"]["gaps"] == []
+
+
+# ===========================================================================
+# autoscaler + brownout + resize edges (ISSUE-13)
+# ===========================================================================
+
+def _signals(routable=1, queue=0, inflight=0, burn=0.0):
+    """A healthy scrape: burn on both windows of both objectives."""
+    return {
+        "routable": routable, "queue_depth": queue, "in_flight": inflight,
+        "burn_rates": {
+            "availability": {"fast": burn, "slow": burn},
+            "latency": {"fast": burn, "slow": burn},
+        },
+    }
+
+
+class _ScriptedScrape:
+    def __init__(self):
+        self.value = _signals()
+
+    def __call__(self):
+        return self.value
+
+
+class TestAutoscalerDecisions:
+    def _fleet(self, tmp_path, *, slots=1, spawn=None, **cfg_kw):
+        cfg_kw.setdefault("min_workers", 1)
+        cfg_kw.setdefault("max_workers", 3)
+        cfg_kw.setdefault("up_consecutive", 2)
+        cfg_kw.setdefault("down_consecutive", 2)
+        cfg_kw.setdefault("interval_s", 1.0)
+        cfg_kw.setdefault("up_cooldown_s", 5.0)
+        cfg_kw.setdefault("down_cooldown_s", 5.0)
+        cfg_kw.setdefault("brownout_exit_ticks", 2)
+        r = _router()
+        mgr = FleetManager(
+            r, str(tmp_path / "store"), num_workers=slots,
+            ports=list(range(20001, 20001 + slots)),
+            spawn=spawn or (lambda slot, bundle: _FakeProc()))
+        mgr.bundle_path = "bundle-a"
+        clock = FakeClock()
+        scrape = _ScriptedScrape()
+        auto = Autoscaler(mgr, AutoscalerConfig(**cfg_kw),
+                          clock=clock, scrape=scrape)
+        mgr.autoscaler = auto
+        return mgr, auto, clock, scrape
+
+    def _tick(self, auto, clock, interval=1.0):
+        clock.now += interval
+        return auto.tick()
+
+    def test_unreachable_scrape_fails_closed_and_resets_streaks(
+            self, tmp_path):
+        # the satellite edge: an autoscaler that cannot see the router
+        # HOLDS — and evidence gathered before the blackout is stale, so
+        # the streak restarts from zero afterwards
+        mgr, auto, clock, scrape = self._fleet(tmp_path)
+        scrape.value = _signals(routable=1, queue=8)  # overloaded tick 1/2
+        assert self._tick(auto, clock) == "hold"
+        scrape.value = None  # router unreachable
+        assert self._tick(auto, clock) == "hold_no_signals"
+        assert len(mgr.slots) == 1  # held, not resized
+        scrape.value = _signals(routable=1, queue=8)
+        assert self._tick(auto, clock) == "hold"  # streak restarted
+        assert self._tick(auto, clock) == "up"  # full streak re-earned
+
+    def test_missing_or_nan_signals_hold(self, tmp_path):
+        mgr, auto, clock, scrape = self._fleet(tmp_path)
+        scrape.value = {"routable": 1, "queue_depth": None, "in_flight": 0}
+        assert self._tick(auto, clock) == "hold_no_signals"
+        scrape.value = {"routable": 1, "queue_depth": float("nan"),
+                        "in_flight": 0}
+        assert self._tick(auto, clock) == "hold_no_signals"
+        # every field fails closed the same way — a NaN in_flight must
+        # not slip through as pressure=NaN (which compares False both
+        # ways and would quietly accumulate calm ticks)
+        scrape.value = {"routable": 1, "queue_depth": 0,
+                        "in_flight": float("nan")}
+        assert self._tick(auto, clock) == "hold_no_signals"
+        scrape.value = {"routable": None, "queue_depth": 0, "in_flight": 0}
+        assert self._tick(auto, clock) == "hold_no_signals"
+        # NaN burn rates (empty SLO windows) qualify nothing: with calm
+        # queues they neither scale up nor block a hold
+        scrape.value = {
+            "routable": 1, "queue_depth": 9, "in_flight": 0,
+            "burn_rates": {
+                "availability": {"fast": float("nan"), "slow": 9.0},
+                "latency": {"fast": float("nan"), "slow": float("nan")},
+            },
+        }
+        # pressure 9 still qualifies the tick (queues are real data) —
+        # but a NaN-mixed burn alone must not
+        assert self._tick(auto, clock) == "hold"
+        assert auto.status()["up_streak"] == 1
+
+    def test_sustained_pressure_scales_up_with_cooldown(self, tmp_path):
+        spawned = []
+
+        def spawn(slot, bundle):
+            spawned.append((slot.id, bundle))
+            return _FakeProc()
+
+        mgr, auto, clock, scrape = self._fleet(tmp_path, spawn=spawn)
+        scrape.value = _signals(routable=1, queue=8)
+        assert self._tick(auto, clock) == "hold"  # hysteresis tick 1/2
+        assert self._tick(auto, clock) == "up"
+        assert len(mgr.slots) == 2
+        assert spawned == [("w1", "bundle-a")]  # current bundle, new id
+        # cooldown: pressure stays high but the next resize must wait
+        scrape.value = _signals(routable=2, queue=12)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "hold_cooldown"
+        clock.now += 5.0  # past up_cooldown_s
+        assert self._tick(auto, clock) == "up"
+        assert len(mgr.slots) == 3
+
+    def test_burn_rate_alone_scales_up(self, tmp_path):
+        # shallow queues but the SLO burning on BOTH windows: the fleet
+        # is failing its objectives — add capacity
+        mgr, auto, clock, scrape = self._fleet(tmp_path)
+        scrape.value = _signals(routable=1, queue=0, burn=2.0)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "up"
+        assert len(mgr.slots) == 2
+
+    def test_calm_scales_down_to_min_and_stops(self, tmp_path, spawn_worker):
+        # two live fake workers so scale-down's drain path has a real
+        # /metrics to watch; both idle -> the drain completes instantly
+        b0, p0 = spawn_worker()
+        b1, p1 = spawn_worker()
+        mgr, auto, clock, scrape = self._fleet(tmp_path, slots=2,
+                                               down_cooldown_s=0.5)
+        mgr.slots[0].port, mgr.slots[0].base_url = (
+            p0, f"http://127.0.0.1:{p0}")
+        mgr.slots[1].port, mgr.slots[1].base_url = (
+            p1, f"http://127.0.0.1:{p1}")
+        mgr.drain_timeout = 2.0
+        for slot in mgr.slots:
+            mgr._launch(slot, "bundle-a")
+        mgr.router.health_pass()
+        mgr.router.health_pass()
+        assert sum(1 for w in mgr.router.workers() if w.routable) == 2
+        scrape.value = _signals(routable=2, queue=0)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "down"
+        assert len(mgr.slots) == 1
+        # at min: calm ticks keep holding, never below min_workers
+        clock.now += 5.0
+        scrape.value = _signals(routable=1, queue=0)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "hold"
+        assert len(mgr.slots) == 1
+
+    def test_scale_down_drains_the_least_loaded_worker(self, tmp_path,
+                                                       spawn_worker):
+        # the satellite edge: w0 is busy (queue 7), w1 idle — the retire
+        # pick must be w1, through the drain handshake
+        busy, p0 = spawn_worker()
+        idle, p1 = spawn_worker()
+        busy.queue_depth = 7
+        mgr, auto, clock, scrape = self._fleet(tmp_path, slots=2)
+        mgr.slots[0].port, mgr.slots[0].base_url = (
+            p0, f"http://127.0.0.1:{p0}")
+        mgr.slots[1].port, mgr.slots[1].base_url = (
+            p1, f"http://127.0.0.1:{p1}")
+        mgr.drain_timeout = 2.0
+        for slot in mgr.slots:
+            mgr._launch(slot, "bundle-a")
+        mgr.router.health_pass()  # admit
+        mgr.router.health_pass()  # scrape loads
+        assert mgr.scale_down_one() is True
+        assert [s.id for s in mgr.slots] == ["w0"]  # the busy one stayed
+        assert idle.draining  # the retired worker got POST /admin/drain
+        assert not busy.draining
+        with pytest.raises(KeyError):
+            mgr.router.worker("w1")  # removed from the router
+
+    def test_resize_queues_behind_a_rolling_upgrade(self, tmp_path):
+        # the satellite edge: a roll holds the cycle lock for minutes —
+        # a resize decided mid-roll must defer, not interleave
+        mgr, auto, clock, scrape = self._fleet(tmp_path)
+        scrape.value = _signals(routable=1, queue=8)
+        assert self._tick(auto, clock) == "hold"
+        assert mgr._cycle_lock.acquire(blocking=False)  # "roll in flight"
+        try:
+            assert self._tick(auto, clock) == "deferred_roll"
+            assert len(mgr.slots) == 1  # nothing interleaved
+        finally:
+            mgr._cycle_lock.release()
+        # first post-roll tick applies the queued resize
+        assert self._tick(auto, clock) == "up"
+        assert len(mgr.slots) == 2
+
+    def test_brownout_enters_escalates_and_exits_only_at_max(
+            self, tmp_path):
+        mgr, auto, clock, scrape = self._fleet(tmp_path, slots=3)
+        r = mgr.router
+        scrape.value = _signals(routable=3, queue=30)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "brownout_enter"
+        assert r.brownout_level == 1
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "brownout_escalate"
+        assert r.brownout_level == 2
+        assert self._tick(auto, clock) == "hold"  # deepest tier: hold
+        # scale-down is forbidden while browned out; calm ticks release
+        # the tiers one by one instead
+        scrape.value = _signals(routable=3, queue=0)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "brownout_exit"
+        assert r.brownout_level == 1
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "brownout_exit"
+        assert r.brownout_level == 0
+        assert len(mgr.slots) == 3  # no resize happened under brownout
+
+    def test_brownout_does_not_latch_on_its_own_sheds(self, tmp_path):
+        # the self-inflicted-burn trap: tier-1 sheds are honest 503s the
+        # SLO rightly counts as failures — if the controller read that
+        # burn as "still overloaded", a trickle of large slabs would
+        # hold brownout (and max size) forever after the real overload
+        # ended. Under brownout, pressure alone is the evidence.
+        mgr, auto, clock, scrape = self._fleet(tmp_path, slots=3)
+        r = mgr.router
+        scrape.value = _signals(routable=3, queue=30)
+        self._tick(auto, clock)
+        assert self._tick(auto, clock) == "brownout_enter"
+        assert r.brownout_level == 1
+        # overload over, but our own sheds keep the burn >= 1 on both
+        # windows: calm ticks must still accumulate and release the tier
+        scrape.value = _signals(routable=3, queue=0, burn=5.0)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "brownout_exit"
+        assert r.brownout_level == 0
+        # out of brownout the burn signal re-arms: sustained burn counts
+        # as overload again (and at max size that means re-entry)
+        assert self._tick(auto, clock) == "hold"
+        assert self._tick(auto, clock) == "brownout_enter"
+
+    def test_status_surfaces_the_loop_state(self, tmp_path):
+        mgr, auto, clock, scrape = self._fleet(tmp_path)
+        scrape.value = _signals(routable=1, queue=8)
+        self._tick(auto, clock)
+        body = mgr.status()["autoscaler"]
+        assert body["min_workers"] == 1 and body["max_workers"] == 3
+        assert body["up_streak"] == 1
+        assert body["last_decision"] == "hold"
+        assert body["signals"]["queue_depth"] == 8
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalerConfig(min_workers=3, max_workers=2).validate()
+        with pytest.raises(ValueError):
+            AutoscalerConfig(up_pressure=1.0, down_pressure=2.0).validate()
+        with pytest.raises(ValueError):
+            AutoscalerConfig(interval_s=0.0).validate()
+        with pytest.raises(ValueError):
+            AutoscalerConfig(brownout_exit_ticks=0).validate()
+        with pytest.raises(ValueError):
+            AutoscalerConfig(up_cooldown_s=-1.0).validate()
+
+
+class TestBrownoutRouter:
+    def test_tier1_sheds_large_sample_slabs_only(self, spawn_worker):
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        r.set_brownout(1, max_rows=2)
+        big = json.dumps({"data": [[0.5]] * 3}).encode()
+        status, payload = r.handle("POST", "/v1/sample", big)
+        assert status == 503
+        assert b"brownout" in payload
+        # small slabs still flow, and classify is never slab-shed
+        assert r.handle("POST", "/v1/sample",
+                        json.dumps({"data": [[0.5]]}).encode())[0] == 200
+        assert r.handle("POST", "/v1/classify", big)[0] == 200
+        m = r.metrics()
+        assert m["brownout_shed"] == 1 and m["brownout_level"] == 1
+
+    def test_tier2_caps_effective_deadlines(self, spawn_worker):
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        r.set_brownout(2, max_rows=64, deadline_s=0.25)
+        r.handle("POST", "/v1/sample",
+                 json.dumps({"data": [[0.5]], "timeout": 9.0}).encode())
+        r.handle("POST", "/v1/sample",
+                 json.dumps({"data": [[0.5]]}).encode())
+        r.handle("POST", "/v1/sample",
+                 json.dumps({"data": [[0.5]], "timeout": 0.1}).encode())
+        touts = [pl.get("timeout") for pl in b.payloads]
+        # 9.0 clamped, missing injected, 0.1 (already tighter) untouched
+        assert touts == [0.25, 0.25, 0.1]
+
+    def test_brownout_surfaces_in_healthz_and_gauge(self, spawn_worker):
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        assert r.healthz()["status"] == "ok"
+        r.set_brownout(1, max_rows=16)
+        body = r.healthz()
+        assert body["status"] == "brownout"
+        assert body["brownout"] == {"active": True, "level": 1,
+                                    "max_sample_rows": 16,
+                                    "deadline_cap_s": 1.0}
+        snap = get_registry().snapshot()
+        [series] = snap["fleet_brownout"]["series"]
+        assert series["value"] == 1.0
+        r.set_brownout(0)
+        assert r.healthz()["status"] == "ok"
+        assert r.healthz()["brownout"]["active"] is False
+
+    def test_brownout_off_passes_everything_through(self, spawn_worker):
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        big = json.dumps({"data": [[0.5]] * 100}).encode()
+        assert r.handle("POST", "/v1/sample", big)[0] == 200
+        assert r.metrics()["brownout_shed"] == 0
+
+    def test_malformed_body_passes_to_the_worker(self, spawn_worker):
+        # admission control must not eat the worker's 400: garbage bodies
+        # pass through untouched even in brownout
+        b, p = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        r.set_brownout(2)
+        status, _ = r.handle("POST", "/v1/sample", b"not json{{{")
+        assert status == 200  # the fake worker answers everything
+        assert b.hits == 1
+
+    def test_brownout_shed_burns_the_slo(self, spawn_worker):
+        from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig
+
+        b, p = spawn_worker()
+        r = _router(slo_config=SLOConfig(availability_target=0.9,
+                                         fast_window_s=30.0,
+                                         slow_window_s=60.0))
+        r.add_worker("w0", f"http://127.0.0.1:{p}")
+        r.health_pass()
+        r.set_brownout(1, max_rows=1)
+        big = json.dumps({"data": [[0.5]] * 4}).encode()
+        for _ in range(4):
+            assert r.handle("POST", "/v1/sample", big)[0] == 503
+        slo = r.slo.snapshot()
+        assert slo["totals"]["failed"] == 4  # honest 503s burn budget
+
+
+class TestSpawnFailureBackoff:
+    def _manager(self, tmp_path, port, procs):
+        def spawn(slot, bundle):
+            proc = _FakeProc()
+            proc._alive = False  # dies before ever becoming routable
+            procs.append(proc)
+            return proc
+
+        r = _router()
+        return FleetManager(r, str(tmp_path / "store"), num_workers=1,
+                            ports=[port], spawn=spawn,
+                            spawn_backoff_base=0.05,
+                            spawn_backoff_max=0.08)
+
+    def test_never_routable_death_backs_off_not_hot_loops(
+            self, tmp_path, spawn_worker):
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        _, port = spawn_worker()
+        procs = []
+        mgr = self._manager(tmp_path, port, procs)
+        slot = mgr.slots[0]
+        mgr._launch(slot, "bundle-a")
+        mgr.bundle_path = "bundle-a"
+        assert len(procs) == 1
+        # first supervise pass observes the death: schedules, no relaunch
+        mgr._supervise_once()
+        assert slot.spawn_failures == 1
+        assert len(procs) == 1  # NOT relaunched in the same pass
+        # hammering supervise inside the backoff window stays a no-op —
+        # the hot-loop shape JG021 polices
+        for _ in range(5):
+            mgr._supervise_once()
+        assert len(procs) == 1
+        time.sleep(0.06)  # past the 0.05s base backoff
+        mgr._supervise_once()
+        assert len(procs) == 2  # one relaunch, after the delay
+        # it died again: the delay doubles (0.1 -> capped at 0.08)
+        mgr._supervise_once()
+        assert slot.spawn_failures == 2
+        time.sleep(0.09)
+        mgr._supervise_once()
+        assert len(procs) == 3
+        events = [e for e in mgr.events if e["event"] == "spawn_failure"]
+        assert [e["failures"] for e in events] == [1, 2]
+        assert events[1]["retry_in_s"] == 0.08  # capped
+        snap = get_registry().snapshot()
+        [series] = snap["fleet_spawn_failures_total"]["series"]
+        assert series["value"] == 2.0
+
+    def test_admission_resets_the_backoff_ladder(self, tmp_path,
+                                                 spawn_worker):
+        behavior, port = spawn_worker()
+        r = _router()
+        flaky = {"n": 0}
+
+        def spawn(slot, bundle):
+            flaky["n"] += 1
+            proc = _FakeProc()
+            proc._alive = flaky["n"] >= 2  # first boot dies, second lives
+            return proc
+
+        mgr = FleetManager(r, str(tmp_path / "store"), num_workers=1,
+                           ports=[port], spawn=spawn,
+                           spawn_backoff_base=0.02, spawn_backoff_max=1.0)
+        slot = mgr.slots[0]
+        mgr._launch(slot, "bundle-a")
+        mgr.bundle_path = "bundle-a"
+        mgr._supervise_once()  # death observed, backoff scheduled
+        assert slot.spawn_failures == 1
+        time.sleep(0.03)
+        mgr._supervise_once()  # relaunch — this process lives
+        r.health_pass()  # probe admits it
+        mgr._supervise_once()  # supervision observes "closed"
+        assert slot.ever_routable
+        assert slot.spawn_failures == 0  # the ladder reset
+        assert slot.next_launch_at is None

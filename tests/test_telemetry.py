@@ -978,6 +978,53 @@ class TestSLOTracker:
         assert snap["burn_rates"]["availability"]["fast"] is None
         assert json.loads(json.dumps(snap, allow_nan=False))["ok"] is False
 
+    def test_backwards_clock_step_is_clamped_monotonic(self):
+        # ISSUE-13 satellite: deployments inject wall clocks, and wall
+        # clocks STEP (NTP slew, VM resume). A backwards step must not
+        # skew window membership — event timestamps clamp to the
+        # high-water mark, so the deque stays sorted and every window
+        # evaluation sees a consistent "now"
+        tracker, clock = self._tracker()
+        for _ in range(10):
+            tracker.record(ok=True, latency_s=0.01)
+        clock["now"] = 920.0  # the wall clock steps BACK 80s
+        for _ in range(10):
+            tracker.record(ok=False)
+        # all 20 events live at clamped t=1000: both windows see all of
+        # them, and the failure fraction is exactly 10/20
+        rates = tracker.burn_rates()
+        assert rates["availability"]["fast"] == pytest.approx(
+            (10 / 20) / 0.01)
+        assert rates["availability"]["slow"] == pytest.approx(
+            (10 / 20) / 0.01)
+        assert tracker.snapshot()["totals"]["requests"] == 20
+        # the deque is still sorted (the prune loop's contract)
+        times = [t for t, _, _ in tracker._events]
+        assert times == sorted(times)
+        # when the clock recovers past the mark, real time resumes and
+        # the fast window ages the burst out
+        clock["now"] = 1015.0  # 15s past the clamp point, fast window 10s
+        rates = tracker.burn_rates()
+        import math as _math
+
+        assert _math.isnan(rates["availability"]["fast"])  # aged out
+        assert rates["availability"]["slow"] == pytest.approx(
+            (10 / 20) / 0.01)
+
+    def test_backwards_step_mid_stream_keeps_window_membership(self):
+        # without the clamp, events recorded at the stepped-back time
+        # land BEHIND newer events in the deque and the prune loop (which
+        # stops at the first in-window timestamp) strands or drops them
+        tracker, clock = self._tracker()
+        tracker.record(ok=False)
+        clock["now"] = 905.0  # back 95s: raw t would be outside slow-100
+        tracker.record(ok=False)
+        clock["now"] = 1000.0
+        rates = tracker.burn_rates()
+        # both events clamped to t=1000: both windows hold both failures
+        assert rates["availability"]["fast"] == pytest.approx(100.0)
+        assert tracker.ok() is False
+
     def test_multi_window_fast_burn_ages_out(self):
         tracker, clock = self._tracker()
         # a burst of failures, then a quiet fast-window: fast recovers,
