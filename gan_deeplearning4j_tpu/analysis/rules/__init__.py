@@ -47,6 +47,9 @@ from gan_deeplearning4j_tpu.analysis.rules.step_io import (
 from gan_deeplearning4j_tpu.analysis.rules.respawn import (
     UnboundedRespawnLoop,
 )
+from gan_deeplearning4j_tpu.analysis.rules.mux_sharing import (
+    CrossGenerationEngineSharing,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -70,6 +73,7 @@ RULES = [
     PrefetchCallbackInTimedRegion(),
     SyncHostIoOnStepPath(),
     UnboundedRespawnLoop(),
+    CrossGenerationEngineSharing(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -81,16 +81,41 @@ class ReloadController:
     def __init__(self, service, watcher: StoreWatcher, *,
                  canary=None, poll_interval: float = 2.0,
                  backoff_max: float = 30.0, drain_timeout: float = 30.0,
-                 build: Optional[Callable] = None):
+                 build: Optional[Callable] = None,
+                 registry=None, adopt_weight: float = 0.0,
+                 adopt_cost: float = 1.0,
+                 adopt_name: str = "gen-{generation}"):
+        """``registry`` switches the controller into MUX mode
+        (docs/MULTIPLEX.md): an admitted candidate is not swapped into a
+        singleton engine but ADOPTED into the
+        :class:`~serving.mux.MuxRegistry` as a new named variant —
+        ``adopt_name`` formatted with the store generation, at
+        ``adopt_weight`` (default 0: no traffic until a ramp admits it)
+        and ``adopt_cost`` (the brownout shed order). The candidate is
+        built against the registry's bucket ladder/replicas with the
+        shared staging pool, the watcher polls against the registry's
+        newest variant generation, and the compatibility + canary gates
+        compare against the registry's primary (highest-weighted
+        resident) engine. ``service`` may be None in this mode."""
         if poll_interval <= 0:
             raise ValueError("poll_interval must be > 0")
+        if service is None and registry is None:
+            raise ValueError("need a service (singleton mode) or a "
+                             "registry (mux mode)")
         self.service = service
         self.watcher = watcher
         self.canary = canary
         self.poll_interval = poll_interval
         self.backoff_max = backoff_max
         self.drain_timeout = drain_timeout
-        self._build = build or _default_build
+        self.registry = registry
+        self.adopt_weight = adopt_weight
+        self.adopt_cost = adopt_cost
+        self.adopt_name = adopt_name
+        if build is None:
+            build = (self._registry_build if registry is not None
+                     else _default_build)
+        self._build = build
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._wake = threading.Event()
@@ -112,10 +137,15 @@ class ReloadController:
             None if watcher.path is None
             else StoreWatcher.dir_token(watcher.path))
         self._swaps = 0
+        self._adopted = 0
         self._rejected = 0
         self._last_error: Optional[str] = None
-        self.events: list = []  # swap/reject records, newest last
+        self.events: list = []  # swap/adopt/reject records, newest last
         registry = get_registry()
+        self._c_adoptions = registry.counter(
+            "deploy_adoptions_total",
+            "candidate generations adopted into the mux registry "
+            "(registry-mode reloads; docs/MULTIPLEX.md)")
         self._c_swaps = registry.counter(
             "deploy_swaps_total",
             "zero-downtime engine swaps completed by the reload plane")
@@ -157,8 +187,10 @@ class ReloadController:
         with self._lock:
             return {
                 "state": self._state,
+                "mode": "registry" if self.registry is not None else "swap",
                 "candidate_generation": self._candidate_generation,
                 "swaps": self._swaps,
+                "adopted": self._adopted,
                 "rejected": self._rejected,
                 "last_error": self._last_error,
             }
@@ -168,6 +200,12 @@ class ReloadController:
             self._state = state
             self._candidate_generation = candidate_generation
         self._g_state.set(_STATE_CODE[state])
+
+    def _registry_build(self, candidate: BundleCandidate, live):
+        """Mux-mode candidate construction: the registry's ONE build
+        recipe (ladder + replicas + shared staging pool), so adopted
+        candidates and budget re-warms can never diverge in config."""
+        return self.registry.build_engine(candidate.path)
 
     # -- forced polls (POST /admin/reload) ------------------------------
     def poll_now(self, wait: bool = False, timeout: float = 60.0) -> dict:
@@ -227,9 +265,18 @@ class ReloadController:
         with self._lock:
             self._busy = True
         try:
-            live = self.service.engine
+            if self.registry is not None:
+                # mux mode: "newer" means newer than ANY adopted variant,
+                # and the compatibility/canary reference is the registry's
+                # primary (None while the registry bootstraps — the first
+                # adopted generation then lands ungated-by-comparison)
+                live = self.registry.reference_engine()
+                current_generation = self.registry.max_generation()
+            else:
+                live = self.service.engine
+                current_generation = live.generation
             candidate = self.watcher.poll_once(
-                current_generation=live.generation,
+                current_generation=current_generation,
                 current_token=self._current_token,
             )
             if candidate is None:
@@ -255,6 +302,10 @@ class ReloadController:
                          f"engine construction failed: "
                          f"{type(exc).__name__}: {exc}", quarantine=True)
             return True
+        if live is None:
+            # mux bootstrap: nothing to compare kinds/widths/quality
+            # against — the first variant defines the reference
+            return self._adopt(candidate, engine)
         missing = set(live.kinds) - set(engine.kinds)
         if missing:
             # a bundle that dropped request kinds would 404 live traffic
@@ -291,6 +342,8 @@ class ReloadController:
                              extra={"candidate_probe": decision.candidate,
                                     "incumbent_probe": decision.incumbent})
                 return True
+        if self.registry is not None:
+            return self._adopt(candidate, engine)
         self._transition("swapping", gen)
         t0 = time.perf_counter()
         old = self.service.batcher.swap_engine(engine)
@@ -316,6 +369,40 @@ class ReloadController:
         self._transition("idle", None)
         logger.info("swapped serving engine: generation %s -> %s (%.3fs)",
                     old.generation, engine.generation, t1 - t0)
+        return True
+
+    def _adopt(self, candidate: BundleCandidate, engine) -> bool:
+        """Mux-mode admission: the warmed (and canaried) candidate joins
+        the registry as a new variant instead of replacing a singleton —
+        at ``adopt_weight`` (default 0: resident and warm, serving
+        nothing until a ramp or an operator gives it weight). Nothing
+        drains: every incumbent variant keeps serving untouched."""
+        gen = candidate.generation
+        name = self.adopt_name.format(generation=gen)
+        self._transition("swapping", gen)
+        try:
+            with TRACER.span("deploy.adopt", generation=gen):
+                self.registry.adopt(
+                    name, engine, bundle_path=candidate.path,
+                    cost=self.adopt_cost, weight=self.adopt_weight,
+                    generation=gen)
+        except ValueError as exc:
+            # a name collision is a config problem, not corruption
+            self._reject(candidate, f"adopt failed: {exc}",
+                         quarantine=False)
+            return True
+        self._c_adoptions.inc()
+        with self._lock:
+            self._adopted += 1
+            self._current_token = candidate.token
+            self._last_error = None
+            self.events.append({
+                "event": "adopt", "generation": gen, "variant": name,
+                "weight": self.adopt_weight,
+            })
+        self._transition("idle", None)
+        logger.info("adopted serving generation %s as mux variant %r "
+                    "(weight %.3f)", gen, name, self.adopt_weight)
         return True
 
     def _drain(self, old) -> bool:

@@ -620,13 +620,23 @@ class FleetRouter:
         self.slo.snapshot()  # refresh the burn-rate gauges into the scrape
         parts: Dict[str, dict] = {}
         gaps: List[str] = []
+        member_labels: Dict[str, dict] = {}
         for ref, snap in self._fan_out("/metrics?scope=registry"):
             if isinstance(snap, dict) and snap:
                 parts[ref.id] = snap
+                # the model/generation dimension: two workers serving
+                # different generations (mid-roll, or a mux fleet) must
+                # not have their per-model counter series summed into
+                # one — the worker's scraped generation labels every
+                # series it contributes (docs/MULTIPLEX.md)
+                gen = ref.generation
+                if gen is not None:
+                    member_labels[ref.id] = {"generation": str(gen)}
             else:
                 gaps.append(ref.id)
         parts["router"] = get_registry().snapshot(include_samples=True)
-        return merge_snapshots(parts, gaps=gaps)
+        return merge_snapshots(parts, gaps=gaps,
+                               member_labels=member_labels)
 
     def _fan_out(self, path: str):
         """Concurrent bounded GETs of ``path`` on every registered worker:

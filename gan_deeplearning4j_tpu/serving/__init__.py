@@ -28,7 +28,13 @@ features) through one dynamic micro-batcher:
   Prometheus text), the served bundle's ``generation``, and the telemetry
   debug hooks (``POST /debug/trace`` device captures, ``GET /debug/spans``
   Chrome trace export — docs/OBSERVABILITY.md);
-- ``python -m gan_deeplearning4j_tpu.serving`` — the server CLI.
+- ``python -m gan_deeplearning4j_tpu.serving`` — the server CLI;
+- :mod:`.mux` — the multi-model multiplexing plane (docs/MULTIPLEX.md):
+  N named variants behind deterministic weighted traffic splitting, a
+  continuous canary ramp with SLO auto-rollback, shared-pool engine
+  residency under a budget, and per-model brownout tiering (imported
+  explicitly — ``from gan_deeplearning4j_tpu.serving.mux import ...`` —
+  so the singleton server never pays for it).
 
 Architecture notes: docs/SERVING.md.
 """

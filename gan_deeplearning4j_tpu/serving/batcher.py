@@ -358,6 +358,14 @@ class MicroBatcher:
         for t in self._completers:
             t.join(timeout=10.0)
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting in the queue right now — the cheap pressure
+        signal (the mux brownout controller polls it every tick;
+        ``metrics()`` would rebuild percentiles per poll)."""
+        with self._lock:
+            return len(self._queue)
+
     # -- the engine-swap seam (deploy/ reload plane) ------------------------
     @property
     def engine(self):

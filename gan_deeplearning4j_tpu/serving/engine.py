@@ -122,6 +122,7 @@ class ServingEngine:
         replicas: Optional[int] = 1,
         generation: Optional[int] = None,
         export_gauge: bool = True,
+        staging_pool=None,
     ):
         import jax
 
@@ -230,6 +231,12 @@ class ServingEngine:
         # at the swap)
         if export_gauge:
             self.export_generation()
+        # staging buffers: private per-engine pools by default; the mux
+        # plane passes ONE shared pool (serving/mux SharedStagingPool)
+        # so N resident engines share buffers instead of each keeping
+        # its own — residency cost scales sub-linearly in variants
+        # (buffers are keyed (bucket, width), model-agnostic bytes)
+        self._shared_staging = staging_pool
         self._staging: Dict[Tuple[str, int], List[_StagingBuf]] = {}
         self._outstanding = [0] * replicas  # in-flight flushes per replica
         self._dispatches = [0] * replicas
@@ -255,6 +262,7 @@ class ServingEngine:
         replicas: Optional[int] = 1,
         generation: Optional[int] = None,
         export_gauge: bool = True,
+        staging_pool=None,
     ) -> "ServingEngine":
         """Restore from serializer checkpoint zips. Updater state is never
         loaded — a serving replica has no optimizer."""
@@ -270,12 +278,13 @@ class ServingEngine:
                 models[role] = (graph, params)
         return cls(models, buckets=buckets, feature_vertex=feature_vertex,
                    replicas=replicas, generation=generation,
-                   export_gauge=export_gauge)
+                   export_gauge=export_gauge, staging_pool=staging_pool)
 
     @classmethod
     def from_bundle(
         cls, directory: str, *, buckets: Sequence[int] = DEFAULT_BUCKETS,
         replicas: Optional[int] = 1, export_gauge: bool = True,
+        staging_pool=None,
     ) -> "ServingEngine":
         """Load a ``serving.json`` bundle published by
         ``GanExperiment.publish_for_serving``."""
@@ -299,6 +308,7 @@ class ServingEngine:
             replicas=replicas,
             generation=manifest.get("generation"),
             export_gauge=export_gauge,
+            staging_pool=staging_pool,
         )
 
     # -- introspection ------------------------------------------------------
@@ -544,6 +554,9 @@ class ServingEngine:
 
     # -- staging pool -------------------------------------------------------
     def _checkout(self, kind: str, bucket: int) -> _StagingBuf:
+        if self._shared_staging is not None:
+            return self._shared_staging.checkout(
+                bucket, self._in_width[kind])
         key = (kind, bucket)
         with self._lock:
             pool = self._staging.get(key)
@@ -552,6 +565,9 @@ class ServingEngine:
         return _StagingBuf(bucket, self._in_width[kind])
 
     def _checkin(self, kind: str, buf: _StagingBuf) -> None:
+        if self._shared_staging is not None:
+            self._shared_staging.checkin(buf)
+            return
         key = (kind, buf.arr.shape[0])
         with self._lock:
             pool = self._staging.setdefault(key, [])

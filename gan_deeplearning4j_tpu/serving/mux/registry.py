@@ -1,0 +1,524 @@
+"""MuxRegistry — N named serving variants behind one residency budget.
+
+The single-model serving process keeps exactly one :class:`ServingEngine`
+alive and hot-swaps it on reload (docs/DEPLOY.md). The multiplexing plane
+generalizes that singleton into a *registry* of named variants — distinct
+store generations, or cheap (bf16-cast) siblings of one generation — each
+wrapped in its own engine + micro-batcher, with three properties the
+singleton never needed (docs/MULTIPLEX.md):
+
+- **shared staging residency** — every resident engine stages its
+  flushes through ONE :class:`SharedStagingPool` (buffers are keyed by
+  ``(bucket, width)`` — model-agnostic pinned bytes), so N resident
+  variants cost ~one engine's worth of staging instead of N: residency
+  scales sub-linearly, which is the whole economic argument for keeping
+  more variants HBM-resident (the μ-cuDNN precision/residency trade,
+  PAPERS.md).
+- **a residency budget with least-weighted eviction** — ``budget``
+  bounds how many engines stay resident. Admitting one more (adopt or
+  re-warm) demotes the least-weighted demotable variant back to its
+  *cold manifest* (bundle path + metadata; engine, batcher, and AOT
+  executables dropped). A cold variant re-warms through the same build
+  path the reload plane uses (``from_bundle`` against the registry's
+  ladder, sync AOT warmup, ``export_gauge=False``) when its weight
+  returns.
+- **one lock for every cross-variant access** — ``lock`` guards the
+  variant table. Every read of another generation's engine/batcher goes
+  through it (or through the accessors here, which take it); jaxlint
+  JG022 polices direct ``.variants``-table access outside the lock, the
+  multi-generation analogue of the JG016 swap-seam rule.
+
+Routing weights live in the registry's :class:`~.splitter.WeightedSplitter`
+(so eviction can ask "least-weighted" of the same numbers requests are
+split by); ``route(key)`` resolves a request key to a (name, batcher)
+pair among *resident, positively-weighted* variants, falling back past
+cold ones (counted — a fallback is a residency-budget miss, the signal an
+operator sizes the budget with).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from gan_deeplearning4j_tpu.serving.batcher import MicroBatcher
+from gan_deeplearning4j_tpu.serving.engine import (
+    DEFAULT_BUCKETS,
+    _StagingBuf,
+)
+from gan_deeplearning4j_tpu.serving.mux.splitter import WeightedSplitter
+from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+from gan_deeplearning4j_tpu.telemetry.trace import TRACER
+
+logger = logging.getLogger(__name__)
+
+#: buffers kept per (bucket, width) key in the shared pool — the same
+#: depth a single engine keeps privately; shared, it serves EVERY
+#: resident variant (that is the sub-linear part)
+_SHARED_POOL_LIMIT = 4
+
+#: variant lifecycle states (mux_variant_state gauge exports the index)
+STATES = ("cold", "warming", "resident", "failed")
+_STATE_CODE = {name: i for i, name in enumerate(STATES)}
+
+
+class SharedStagingPool:
+    """One pinned-staging-buffer pool shared by every resident engine.
+
+    Buffers are plain ``(bucket, width)`` float32 arrays with a
+    high-water zero tail (:class:`~..engine._StagingBuf`) — nothing about
+    them is model-specific, so variants of any generation can recycle
+    each other's. ``checkout``/``checkin`` mirror the engine's private
+    pool API; the pool never blocks (an empty pool allocates)."""
+
+    def __init__(self, per_key_limit: int = _SHARED_POOL_LIMIT):
+        if per_key_limit < 1:
+            raise ValueError("per_key_limit must be >= 1")
+        self._limit = per_key_limit
+        self._lock = threading.Lock()
+        self._pools: Dict[Tuple[int, int], List[_StagingBuf]] = {}
+        self._allocated = 0
+
+    def checkout(self, bucket: int, width: int) -> _StagingBuf:
+        key = (int(bucket), int(width))
+        with self._lock:
+            pool = self._pools.get(key)
+            if pool:
+                return pool.pop()
+            self._allocated += 1
+        return _StagingBuf(key[0], key[1])
+
+    def checkin(self, buf: _StagingBuf) -> None:
+        key = (buf.arr.shape[0], buf.arr.shape[1])
+        with self._lock:
+            pool = self._pools.setdefault(key, [])
+            if len(pool) < self._limit:
+                pool.append(buf)
+
+    def stats(self) -> dict:
+        with self._lock:
+            pooled = sum(len(p) for p in self._pools.values())
+            pooled_bytes = sum(
+                b.arr.nbytes for p in self._pools.values() for b in p)
+            return {
+                "allocated_total": self._allocated,
+                "pooled": pooled,
+                "pooled_bytes": pooled_bytes,
+                "keys": len(self._pools),
+            }
+
+
+class MuxVariant:
+    """One named serving variant: a cold manifest always, an engine +
+    batcher only while resident. Mutated ONLY under the registry lock."""
+
+    __slots__ = ("name", "bundle_path", "cost", "generation", "state",
+                 "engine", "batcher", "last_error", "added_at",
+                 "warmed_at")
+
+    def __init__(self, name: str, *, bundle_path: Optional[str],
+                 cost: float, generation):
+        self.name = name
+        self.bundle_path = bundle_path
+        self.cost = float(cost)
+        self.generation = generation
+        self.state = "cold"
+        self.engine = None
+        self.batcher = None
+        self.last_error: Optional[str] = None
+        self.added_at = time.time()
+        self.warmed_at: Optional[float] = None
+
+    def snapshot(self, weight: float) -> dict:
+        engine = self.engine
+        return {
+            "name": self.name,
+            "state": self.state,
+            "cost": self.cost,
+            "weight": weight,
+            "generation": self.generation,
+            "bundle_path": self.bundle_path,
+            "resident": self.state == "resident",
+            "warm": bool(engine is not None and engine.warmed),
+            "last_error": self.last_error,
+        }
+
+
+class MuxRegistry:
+    """The variant table + splitter + residency policy (module docstring).
+
+    ``build`` is injectable for tests: ``(variant) -> engine``; the
+    default loads ``ServingEngine.from_bundle`` against the registry's
+    bucket ladder and replica count with the shared staging pool
+    attached. ``batcher_kwargs`` applies to every variant's
+    :class:`MicroBatcher` (``max_batch`` defaults to the ladder top)."""
+
+    def __init__(self, *, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 replicas: int = 1, budget: int = 2,
+                 batcher_kwargs: Optional[dict] = None,
+                 build: Optional[Callable] = None,
+                 staging_pool: Optional[SharedStagingPool] = None):
+        if budget < 1:
+            raise ValueError("residency budget must be >= 1")
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.replicas = int(replicas)
+        self.budget = int(budget)
+        self.pool = staging_pool or SharedStagingPool()
+        self.splitter = WeightedSplitter()
+        self._build = build or self._default_build
+        self._batcher_kwargs = dict(batcher_kwargs or {})
+        # THE cross-generation lock (jaxlint JG022): every access to the
+        # variant table — and through it to another generation's engine
+        # or batcher — holds it. RLock: accessors compose (snapshot()
+        # calls primary_name() and such under one acquisition).
+        self.lock = threading.RLock()
+        self._variants: Dict[str, MuxVariant] = {}
+        self.events: List[dict] = []
+        registry = get_registry()
+        self._g_resident = registry.gauge(
+            "mux_variants_resident",
+            "engines currently resident in the mux registry")
+        self._g_weight = registry.gauge(
+            "mux_variant_weight",
+            "live routing weight per variant (0 = no new traffic)",
+            labelnames=("model",))
+        self._g_state = registry.gauge(
+            "mux_variant_state",
+            "variant lifecycle: 0=cold 1=warming 2=resident 3=failed",
+            labelnames=("model",))
+        self._c_evictions = registry.counter(
+            "mux_evictions_total",
+            "variants demoted from resident engines to cold manifests by "
+            "the residency budget", labelnames=("model",))
+        self._c_warmups = registry.counter(
+            "mux_warmups_total",
+            "engine builds (adopt or cold re-warm) per variant",
+            labelnames=("model",))
+        self._c_fallbacks = registry.counter(
+            "mux_route_fallbacks_total",
+            "requests whose assigned variant was not resident and fell "
+            "back to the resident pool (residency-budget misses)")
+
+    # -- builds (the PR 7 reloader path, shared-pool edition) -------------
+    def build_engine(self, bundle_path: str):
+        """THE build recipe for this registry's engines — the registry's
+        ladder and replica count (every variant compiles the executables
+        the splitter routes to) with the shared staging pool attached.
+        The registry-mode reload plane builds its candidates through
+        this too, so adopted and re-warmed engines can never diverge in
+        config."""
+        from gan_deeplearning4j_tpu.serving.engine import ServingEngine
+
+        return ServingEngine.from_bundle(
+            bundle_path,
+            buckets=self.buckets,
+            replicas=self.replicas,
+            export_gauge=False,
+            staging_pool=self.pool,
+        )
+
+    def _default_build(self, variant: MuxVariant):
+        if variant.bundle_path is None:
+            raise ValueError(
+                f"variant {variant.name!r} has no bundle manifest to "
+                f"build from")
+        return self.build_engine(variant.bundle_path)
+
+    def _make_batcher(self, engine) -> MicroBatcher:
+        kwargs = dict(self._batcher_kwargs)
+        kwargs.setdefault("max_batch", self.buckets[-1])
+        return MicroBatcher(engine=engine, **kwargs)
+
+    # -- variant management ----------------------------------------------
+    def add(self, name: str, *, bundle_path: Optional[str] = None,
+            engine=None, cost: float = 1.0, weight: float = 0.0,
+            generation=None) -> MuxVariant:
+        """Register a variant. With ``engine`` (already built + warmed —
+        the adopt path) it becomes resident immediately; with only a
+        ``bundle_path`` it stays a cold manifest until its weight asks
+        for residency. ``cost`` is the relative serve cost (bf16 sibling
+        < fp32 original) the per-model brownout sheds by — highest cost
+        sheds first (docs/MULTIPLEX.md)."""
+        if bundle_path is None and engine is None:
+            raise ValueError("a variant needs a bundle_path or an engine")
+        if cost <= 0:
+            raise ValueError("cost must be > 0")
+        name = str(name)
+        if generation is None and engine is not None:
+            generation = engine.generation
+        variant = MuxVariant(name, bundle_path=bundle_path, cost=cost,
+                             generation=generation)
+        with self.lock:
+            if name in self._variants:
+                raise ValueError(f"variant {name!r} already registered")
+            self._variants[name] = variant
+            if engine is not None:
+                self._attach_locked(variant, engine)
+        self.splitter.set_weight(name, weight)
+        self._g_weight.labels(model=name).set(float(weight))
+        if engine is not None:
+            self._enforce_budget(protect=name)
+        elif weight > 0.0:
+            self.ensure_resident(name)
+        return variant
+
+    def adopt(self, name: str, engine, *, bundle_path: Optional[str] = None,
+              cost: float = 1.0, weight: float = 0.0,
+              generation=None) -> MuxVariant:
+        """The reload plane's entry point (docs/DEPLOY.md): a newly
+        warmed candidate engine joins the registry as a variant —
+        typically at weight 0, ready for a ramp — instead of replacing a
+        singleton. The residency budget applies immediately."""
+        variant = self.add(name, bundle_path=bundle_path, engine=engine,
+                           cost=cost, weight=weight, generation=generation)
+        with self.lock:
+            self.events.append({"event": "adopt", "variant": name,
+                                "generation": variant.generation})
+        return variant
+
+    def remove(self, name: str) -> None:
+        """Drop a variant entirely (demoting it first when resident)."""
+        self.demote(name)
+        with self.lock:
+            self._variants.pop(name, None)
+        self.splitter.remove(name)
+
+    def _attach_locked(self, variant: MuxVariant, engine) -> None:
+        variant.engine = engine
+        variant.batcher = self._make_batcher(engine)
+        variant.state = "resident"
+        variant.warmed_at = time.time()
+        variant.last_error = None
+        if variant.generation is None:
+            variant.generation = engine.generation
+        self._g_state.labels(model=variant.name).set(
+            _STATE_CODE["resident"])
+        self._g_resident.set(
+            sum(1 for v in self._variants.values()
+                if v.state == "resident"))
+
+    # -- residency --------------------------------------------------------
+    def ensure_resident(self, name: str) -> MuxVariant:
+        """Re-warm a cold variant through the reloader-style build path:
+        engine from the cold manifest against the registry ladder +
+        shared pool, sync AOT warmup, then attach. The (multi-second)
+        build runs OUTSIDE the lock — routing to other variants never
+        stalls behind a warmup."""
+        with self.lock:
+            variant = self._variants[name]
+            if variant.state == "resident":
+                return variant
+            if variant.state == "warming":
+                raise RuntimeError(f"variant {name!r} is already warming")
+            variant.state = "warming"
+        self._g_state.labels(model=name).set(_STATE_CODE["warming"])
+        try:
+            with TRACER.span("mux.warm", variant=name):
+                engine = self._build(variant)
+                engine.warmup()
+            self._c_warmups.labels(model=name).inc()
+        except Exception as exc:
+            with self.lock:
+                variant.state = "failed"
+                variant.last_error = f"{type(exc).__name__}: {exc}"
+            self._g_state.labels(model=name).set(_STATE_CODE["failed"])
+            raise
+        with self.lock:
+            self._attach_locked(variant, engine)
+            self.events.append({"event": "warm", "variant": name,
+                                "generation": variant.generation})
+        self._enforce_budget(protect=name)
+        return variant
+
+    def demote(self, name: str) -> bool:
+        """Resident → cold manifest: detach engine + batcher under the
+        lock, then drain/close the batcher and drop the engine outside
+        it (in-flight requests finish on the detached pair; new route()
+        calls no longer see the variant). False when not resident."""
+        with self.lock:
+            variant = self._variants.get(name)
+            if variant is None or variant.state != "resident":
+                return False
+            batcher, engine = variant.batcher, variant.engine
+            variant.batcher = None
+            variant.engine = None
+            variant.state = "cold"
+            self.events.append({"event": "demote", "variant": name,
+                                "generation": variant.generation})
+            self._g_resident.set(
+                sum(1 for v in self._variants.values()
+                    if v.state == "resident"))
+        self._g_state.labels(model=name).set(_STATE_CODE["cold"])
+        if batcher is not None:
+            batcher.close(drain=True)
+        del engine  # AOT executables + device params released with it
+        return True
+
+    def _enforce_budget(self, protect: Optional[str] = None) -> None:
+        """Demote least-weighted demotable residents until the count fits
+        the budget. ``protect`` exempts the variant just admitted (the
+        newcomer must not evict itself). A variant with no cold manifest
+        (engine-only, nothing to re-warm from) is never demoted."""
+        while True:
+            weights = self.splitter.weights()
+            with self.lock:
+                residents = [v for v in self._variants.values()
+                             if v.state == "resident"]
+                if len(residents) <= self.budget:
+                    return
+                demotable = [
+                    v for v in residents
+                    if v.bundle_path is not None and v.name != protect]
+                if not demotable:
+                    return  # over budget but nothing safely demotable
+                victim = min(
+                    demotable,
+                    key=lambda v: (weights.get(v.name, 0.0), -v.cost,
+                                   v.name))
+                victim_name = victim.name
+            self._c_evictions.labels(model=victim_name).inc()
+            self.demote(victim_name)
+
+    # -- weights ----------------------------------------------------------
+    def set_weight(self, name: str, weight: float,
+                   warm: bool = True) -> None:
+        """Live weight update. Raising a cold variant's weight above 0
+        re-warms it first (``warm=False`` skips that — the caller will
+        warm explicitly), so traffic is never assigned to a variant that
+        cannot serve it without a fallback."""
+        with self.lock:
+            variant = self._variants[name]
+            state = variant.state
+        if weight > 0.0 and state == "cold" and warm:
+            self.ensure_resident(name)
+        self.splitter.set_weight(name, weight)
+        self._g_weight.labels(model=name).set(float(weight))
+
+    def set_weights(self, weights: Dict[str, float],
+                    warm: bool = True) -> None:
+        """Atomic multi-variant weight transition (one splitter lock —
+        a ramp step is never observed half-applied). The weights land
+        FIRST, then any cold variant gaining weight is re-warmed
+        best-effort: a ramp rollback must restore the incumbents'
+        traffic shares immediately even when one of them was
+        budget-evicted mid-ramp and its multi-second re-warm (or a
+        failing one) would otherwise delay — or worse, skip — the
+        restore. Until the warm lands, that variant's keys take the
+        counted fallback path (``mux_route_fallbacks_total``)."""
+        self.splitter.set_weights(weights)
+        for name, weight in weights.items():
+            self._g_weight.labels(model=name).set(float(weight))
+        if not warm:
+            return
+        with self.lock:
+            cold = [n for n, w in weights.items()
+                    if w > 0.0 and n in self._variants
+                    and self._variants[n].state == "cold"]
+        for name in cold:
+            try:
+                self.ensure_resident(name)
+            except Exception:
+                # the variant stays failed/cold and its traffic falls
+                # back to the resident pool — degraded but serving,
+                # never a lost weight transition
+                logger.exception("re-warm of weighted variant %r failed",
+                                 name)
+
+    # -- routing ----------------------------------------------------------
+    def route(self, key: str) -> Tuple[str, MicroBatcher]:
+        """Resolve a request key to (variant name, its batcher) among
+        resident, positively-weighted variants. When the key's
+        *unrestricted* assignment names a non-resident variant, the
+        request falls back to the resident pool by the same rendezvous
+        order and the miss is counted (``mux_route_fallbacks_total``)."""
+        weights = self.splitter.weights()
+        with self.lock:
+            resident = [n for n, v in self._variants.items()
+                        if v.state == "resident"
+                        and weights.get(n, 0.0) > 0.0]
+            if not resident:
+                raise LookupError(
+                    "no resident variant carries positive weight")
+            name = self.splitter.assign(key, among=resident)
+            if any(w > 0.0 and n not in resident
+                   for n, w in weights.items()):
+                if self.splitter.assign(key) != name:
+                    self._c_fallbacks.inc()
+            return name, self._variants[name].batcher
+
+    # -- accessors (all take the lock — the JG022-clean surface) ----------
+    def names(self) -> List[str]:
+        with self.lock:
+            return list(self._variants)
+
+    def resident_names(self) -> List[str]:
+        with self.lock:
+            return [n for n, v in self._variants.items()
+                    if v.state == "resident"]
+
+    def engine_for(self, name: str):
+        with self.lock:
+            return self._variants[name].engine
+
+    def batcher_for(self, name: str) -> Optional[MicroBatcher]:
+        with self.lock:
+            return self._variants[name].batcher
+
+    def variant(self, name: str) -> MuxVariant:
+        with self.lock:
+            return self._variants[name]
+
+    def generations(self) -> Dict[str, object]:
+        with self.lock:
+            return {n: v.generation for n, v in self._variants.items()}
+
+    def max_generation(self) -> Optional[int]:
+        """The newest store generation any variant carries — what the
+        registry-mode reload watcher polls against (docs/DEPLOY.md)."""
+        with self.lock:
+            gens = [v.generation for v in self._variants.values()
+                    if isinstance(v.generation, int)]
+        return max(gens) if gens else None
+
+    def primary_name(self) -> Optional[str]:
+        """The highest-weighted resident variant — the reload plane's
+        incumbent for compatibility checks and canary probes."""
+        weights = self.splitter.weights()
+        with self.lock:
+            residents = [n for n, v in self._variants.items()
+                         if v.state == "resident"]
+        if not residents:
+            return None
+        return max(residents, key=lambda n: (weights.get(n, 0.0), n))
+
+    def reference_engine(self):
+        name = self.primary_name()
+        return None if name is None else self.engine_for(name)
+
+    def costs(self) -> Dict[str, float]:
+        with self.lock:
+            return {n: v.cost for n, v in self._variants.items()}
+
+    def snapshot(self) -> dict:
+        weights = self.splitter.weights()
+        with self.lock:
+            variants = {n: v.snapshot(weights.get(n, 0.0))
+                        for n, v in self._variants.items()}
+            resident = sum(1 for v in self._variants.values()
+                           if v.state == "resident")
+        return {
+            "variants": variants,
+            "resident": resident,
+            "budget": self.budget,
+            "buckets": list(self.buckets),
+            "replicas": self.replicas,
+            "shares": self.splitter.shares(),
+            "staging_pool": self.pool.stats(),
+        }
+
+    def close(self) -> None:
+        """Demote everything (drains every batcher) — shutdown path."""
+        for name in self.resident_names():
+            self.demote(name)

@@ -56,7 +56,9 @@ def _label_key(labels: dict) -> tuple:
 
 
 def merge_snapshots(parts: Dict[str, dict],
-                    gaps: Iterable[str] = ()) -> dict:
+                    gaps: Iterable[str] = (),
+                    member_labels: Optional[Dict[str, dict]] = None
+                    ) -> dict:
     """Merge member snapshots into one fleet snapshot.
 
     ``parts`` maps member id (worker id, ``"router"``) to that process's
@@ -64,12 +66,24 @@ def merge_snapshots(parts: Dict[str, dict],
     members whose scrape failed. Malformed families or members are
     recorded under ``_fleet.conflicts`` and skipped — an aggregation
     endpoint must degrade to a labeled partial view, never 500.
-    """
+
+    ``member_labels`` maps a member id to extra labels stamped on EVERY
+    series that member contributes — the model/generation dimension
+    (docs/MULTIPLEX.md): two workers serving different generations emit
+    identical ``serve_requests_total{kind,status}`` series, and without
+    a distinguishing label the merge would sum them into one number,
+    collapsing the per-model story. The router passes each worker's
+    scraped ``generation`` here, so the merged counters keep one series
+    per (labels × generation). Pass-through only fills labels a series
+    does not already carry — a worker's own per-model labels (the mux
+    plane's ``model=...``) always win."""
     families: dict = {}
     conflicts: list = []
+    member_labels = member_labels or {}
     # accumulators: family -> label_key -> merged state
     for member in sorted(parts):
         snapshot = parts[member]
+        extra = member_labels.get(member) or {}
         if not isinstance(snapshot, dict):
             conflicts.append(f"{member}: snapshot is not an object")
             continue
@@ -92,6 +106,10 @@ def merge_snapshots(parts: Dict[str, dict],
                 if not isinstance(s, dict):
                     continue
                 labels = dict(s.get("labels") or {})
+                for k, v in extra.items():
+                    # member-level dimension (generation/model): fill,
+                    # never override a label the series already carries
+                    labels.setdefault(str(k), str(v))
                 if kind == "gauge":
                     # one fact per member: label, don't sum
                     labels["worker"] = member

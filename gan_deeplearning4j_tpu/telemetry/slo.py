@@ -83,7 +83,17 @@ class SLOTracker:
     WINDOWS = ("fast", "slow")
 
     def __init__(self, config: Optional[SLOConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 *, metric_prefix: str = "fleet",
+                 labels: Optional[dict] = None):
+        """``metric_prefix``/``labels`` scope the exported gauges: the
+        router's fleet-wide tracker keeps the default
+        ``fleet_slo_burn_rate{objective,window}``; the mux plane tracks
+        one SLI stream PER VARIANT with ``metric_prefix="mux"`` and
+        ``labels={"model": name}``, so every tracker's burn rates land
+        as distinct labeled series of one ``mux_slo_*`` family instead
+        of N trackers fighting over one unlabeled series
+        (docs/MULTIPLEX.md)."""
         self.config = (config or SLOConfig()).validate()
         self._clock = clock
         self._lock = threading.Lock()
@@ -94,16 +104,23 @@ class SLOTracker:
         # high-water mark of observed clock readings: event timestamps
         # are clamped monotonic against it (see _now_locked)
         self._clock_hwm: Optional[float] = None
+        self._labels = {str(k): str(v)
+                        for k, v in sorted((labels or {}).items())}
+        extra = tuple(self._labels)
         registry = get_registry()
-        self._g_burn = registry.gauge(
-            "fleet_slo_burn_rate",
+        burn_family = registry.gauge(
+            f"{metric_prefix}_slo_burn_rate",
             "error-budget burn rate per objective and window "
             "(NaN = empty window, fails closed)",
-            labelnames=("objective", "window"))
-        self._g_ok = registry.gauge(
-            "fleet_slo_ok",
+            labelnames=extra + ("objective", "window"))
+        self._g_burn = lambda objective, window: burn_family.labels(
+            **self._labels, objective=objective, window=window)
+        ok_family = registry.gauge(
+            f"{metric_prefix}_slo_ok",
             "1 when every objective's fast AND slow burn rates are under "
-            "1.0, 0 otherwise (NaN burn = 0 — no data fails closed)")
+            "1.0, 0 otherwise (NaN burn = 0 — no data fails closed)",
+            labelnames=extra)
+        self._g_ok = ok_family.labels(**self._labels) if extra else ok_family
 
     # -- recording -------------------------------------------------------
     def _now_locked(self) -> float:
@@ -205,8 +222,7 @@ class SLOTracker:
         rates = self.burn_rates()
         for objective, windows in rates.items():
             for window, burn in windows.items():
-                self._g_burn.labels(objective=objective,
-                                    window=window).set(burn)
+                self._g_burn(objective, window).set(burn)
         # recompute from the rates already in hand (ok() would re-read
         # the clock and could disagree with the exported rates)
         signal = all(

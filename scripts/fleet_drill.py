@@ -662,6 +662,307 @@ def run_autoscale(args) -> int:
     return 0 if ok else 1
 
 
+# ===========================================================================
+# the multi-model multiplexing drill (--mux)
+# ===========================================================================
+
+def _mux_counts(snapshot: dict) -> dict:
+    """Per-model outcome totals off ``mux_requests_total`` (summed over
+    kinds): {model: {status: count}}."""
+    out: dict = {}
+    for s in snapshot.get("mux_requests_total", {}).get("series", []):
+        labels = s.get("labels", {})
+        model, status = labels.get("model"), labels.get("status")
+        per = out.setdefault(model, {})
+        per[status] = per.get(status, 0.0) + float(s.get("value", 0.0))
+    return out
+
+
+def _brownout_sheds(snapshot: dict) -> dict:
+    return {
+        s["labels"]["model"]: float(s["value"])
+        for s in snapshot.get("mux_brownout_sheds_total",
+                              {}).get("series", [])
+    }
+
+
+def run_mux(args) -> int:
+    """The multiplexing drill (docs/MULTIPLEX.md): real engines from
+    three seeded store generations behind one MuxService, driven
+    in-process over real HTTP:
+
+    1. **split** — two variants (expensive "heavy" at 90%, cheap "lite"
+       at 10%) under closed-loop load: zero lost, both served, observed
+       split within tolerance of the weights.
+    2. **ramp + injected burn** — a third generation is adopted and
+       ramped 1% → 10% → 50% → 100% on its own per-variant SLO signal;
+       a burst of injected failures into the candidate's tracker must
+       AUTO-ROLLBACK the ramp (weights restored exactly), then a clean
+       re-ramp must complete with the candidate elected primary.
+    3. **brownout** — synthetic overload (big-slab closed-loop burst
+       against a small queue) must walk the per-model brownout tier up:
+       the expensive variant sheds with honest 503s while the cheap one
+       keeps answering; quiesce releases the tier.
+
+    The exactly-one-answer ledger holds across all phases."""
+    import numpy as np  # noqa: F811 (drill-local import shape)
+
+    from gan_deeplearning4j_tpu.resilience import CheckpointStore
+    from gan_deeplearning4j_tpu.serving import make_server
+    from gan_deeplearning4j_tpu.serving.mux import (
+        BrownoutController,
+        MuxRegistry,
+        MuxService,
+        health_from_tracker,
+    )
+    from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+    from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_mux_")
+    cleanup = args.workdir is None
+    os.makedirs(workdir, exist_ok=True)
+    serve_store = os.path.join(workdir, "store_serve")
+    workload = make_workload(workdir, args.seed)
+    z_size = 4  # the drill workload's latent width (make_workload)
+    split_seconds = 5.0 if args.smoke else 8.0
+    results: dict = {}
+    invariants: dict = {}
+    server = svc = load = None
+    t_start = time.monotonic()
+
+    try:
+        # -- phase 0: seed three generations, boot the mux service -------
+        bundles = []
+        store = CheckpointStore(serve_store, keep_last=args.keep_last)
+        for i in range(3):
+            gen_number = seed_bundle(workload, serve_store, args.keep_last)
+            bundles.append((gen_number, store.latest_valid().path))
+        log(f"seeded serving generations "
+            f"{[n for n, _ in bundles]} into {serve_store}")
+        registry = MuxRegistry(
+            buckets=(1, 8), budget=3,
+            batcher_kwargs={"max_latency": 0.002, "max_queue": 12,
+                            "default_timeout": 5.0})
+        # the cost gradient the brownout sheds by: "heavy" is the
+        # expensive fp32 primary, "lite" the cheap sibling
+        registry.add("heavy", bundle_path=bundles[0][1], cost=4.0,
+                     weight=0.9, generation=bundles[0][0])
+        registry.add("lite", bundle_path=bundles[1][1], cost=1.0,
+                     weight=0.1, generation=bundles[1][0])
+        svc = MuxService(
+            registry,
+            slo_config=SLOConfig(
+                availability_target=0.9, latency_target=0.9,
+                latency_threshold_s=2.0,
+                fast_window_s=2.0, slow_window_s=8.0),
+            brownout=BrownoutController(
+                threshold=0.25, enter_ticks=2, exit_ticks=6))
+        svc.start_control_loop(interval=0.2)
+        server = make_server(svc, port=0)
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        health = fleet_health(base)
+        invariants["boots_ok"] = health.get("status") == "ok"
+        invariants["shared_pool_attached"] = (
+            registry.engine_for("heavy")._shared_staging is registry.pool)
+        log(f"mux service up at {base}: "
+            f"variants {sorted(registry.names())}")
+
+        # -- phase 1: 10/90 split under closed-loop load ------------------
+        load = LoadGenerator(base, z_size, threads=4, pace=0.004)
+        load.start()
+        time.sleep(split_seconds)
+        counts = _mux_counts(get_registry().snapshot())
+        heavy_ok = counts.get("heavy", {}).get("ok", 0.0)
+        lite_ok = counts.get("lite", {}).get("ok", 0.0)
+        served = heavy_ok + lite_ok
+        lite_share = (lite_ok / served) if served else float("nan")
+        results["split"] = {
+            "requests": served, "heavy_ok": heavy_ok, "lite_ok": lite_ok,
+            "lite_share_observed": lite_share, "lite_share_expected": 0.1,
+        }
+        invariants["split_serves_both_variants"] = (
+            heavy_ok > 0 and lite_ok > 0)
+        # binomial tolerance, wide enough for a short smoke window
+        invariants["split_matches_weights"] = (
+            served >= 200 and 0.04 <= lite_share <= 0.20)
+        log(f"split: {served:.0f} served, lite share "
+            f"{lite_share:.3f} (want ~0.10)")
+
+        # -- phase 2: ramp with one injected SLO burn → auto-rollback -----
+        registry.add("cand", bundle_path=bundles[2][1], cost=1.0,
+                     weight=0.0, generation=bundles[2][0])
+        # generous holds: the injection below must land while the ramp
+        # is still mid-ladder, not race a sprinting one
+        ramp = svc.start_ramp("cand", stages=(0.01, 0.10, 0.50, 1.0),
+                              hold_ticks=10)
+        mid = wait_for(
+            lambda: (ramp.snapshot().get("fraction") or 0.0) >= 0.10
+            or ramp.state != "ramping",
+            60.0, "ramp reaches the 10% stage")
+        invariants["ramp_reaches_mid_stage"] = bool(
+            mid and ramp.state == "ramping")
+        # the injected burn: a failure burst into the candidate's OWN
+        # SLI stream (the signal the rollback rule reads) — the mux
+        # analogue of the resilience drill's fault injections
+        tracker = svc.tracker_for("cand")
+        for _ in range(200):
+            tracker.record(False)
+        rolled = wait_for(lambda: ramp.state == "rolled_back", 20.0,
+                          "ramp auto-rollback on the injected burn")
+        weights = registry.splitter.weights()
+        invariants["ramp_rolls_back_on_burn"] = bool(rolled)
+        invariants["rollback_restores_weights"] = (
+            weights.get("cand") == 0.0
+            and abs(weights.get("heavy", 0) - 0.9) < 1e-9
+            and abs(weights.get("lite", 0) - 0.1) < 1e-9)
+        results["ramp_rollback"] = {
+            "rollbacks": ramp.rollbacks,
+            "events": list(ramp.events),
+            "weights_after": weights,
+        }
+        log(f"ramp rolled back (events: "
+            f"{[e['event'] for e in ramp.events]})")
+
+        # -- phase 3: clean re-ramp completes 1% → 100% -------------------
+        # the injected failures must first age out of the candidate's
+        # fast window, or the re-ramp reads yesterday's burn and rolls
+        # back on stale evidence
+        burn_gone = wait_for(
+            lambda: health_from_tracker(tracker)() is not False,
+            20.0, "injected burn ages out of the fast window",
+            interval=0.5)
+        invariants["injected_burn_ages_out"] = bool(burn_gone)
+        ramp2 = svc.start_ramp("cand", stages=(0.01, 0.10, 0.50, 1.0),
+                               hold_ticks=2)
+        done = wait_for(
+            lambda: ramp2.state in ("complete", "rolled_back"),
+            120.0, "clean ramp completion")
+        invariants["ramp_completes"] = (
+            done is not None and ramp2.state == "complete")
+        invariants["candidate_elected_primary"] = (
+            registry.primary_name() == "cand"
+            and registry.splitter.shares() == {"cand": 1.0})
+        results["ramp_complete"] = {
+            "state": ramp2.state,
+            "events": list(ramp2.events),
+            "shares": registry.splitter.shares(),
+        }
+        log(f"clean ramp: {ramp2.state}, primary "
+            f"{registry.primary_name()}")
+
+        # -- phase 4: synthetic overload → per-model brownout -------------
+        # restore the two-variant split so the cost gradient is live
+        # (cand keeps zero weight; level-2 would shed it before "lite")
+        registry.set_weights({"heavy": 0.6, "lite": 0.4, "cand": 0.0})
+        sheds_before = _brownout_sheds(get_registry().snapshot())
+        counts_before = _mux_counts(get_registry().snapshot())
+        # big-slab closed-loop burst: 256-row slabs chunk through the
+        # 8-bucket ladder (32 real flushes each), backing the small
+        # (max_queue=16) per-variant queues up — real queue pressure,
+        # synthetic only in its shape
+        slab_stop = threading.Event()
+
+        def slab_client(tid: int) -> None:
+            rng = np.random.default_rng(7000 + tid)
+            while not slab_stop.is_set():
+                rows = rng.random((256, z_size), dtype=np.float32)
+                http_json("POST", f"{base}/v1/sample",
+                          {"data": rows.tolist()}, timeout=30.0)
+
+        slab_threads = [
+            threading.Thread(target=slab_client, args=(i,), daemon=True)
+            for i in range(16)
+        ]
+        for t in slab_threads:
+            t.start()
+        engaged = wait_for(lambda: svc.brownout_level >= 1, 30.0,
+                           "brownout engages under the slab burst")
+        level_seen = svc.brownout_level
+        # hold the burst briefly so sheds accumulate while engaged
+        time.sleep(2.0)
+        sheds_mid = _brownout_sheds(get_registry().snapshot())
+        slab_stop.set()
+        for t in slab_threads:
+            t.join(timeout=40.0)
+        heavy_sheds = (sheds_mid.get("heavy", 0.0)
+                       - sheds_before.get("heavy", 0.0))
+        lite_sheds = (sheds_mid.get("lite", 0.0)
+                      - sheds_before.get("lite", 0.0))
+        counts_mid = _mux_counts(get_registry().snapshot())
+        lite_ok_during = (counts_mid.get("lite", {}).get("ok", 0.0)
+                          - counts_before.get("lite", {}).get("ok", 0.0))
+        invariants["brownout_engages_under_overload"] = bool(engaged)
+        invariants["brownout_sheds_expensive_first"] = (
+            heavy_sheds > 0 and lite_sheds == 0)
+        invariants["cheap_variant_serves_through_brownout"] = (
+            lite_ok_during > 0)
+        released = wait_for(lambda: svc.brownout_level == 0, 30.0,
+                            "brownout releases after quiesce")
+        invariants["brownout_releases_after_quiesce"] = bool(released)
+        results["brownout"] = {
+            "max_level_seen": level_seen,
+            "heavy_sheds": heavy_sheds,
+            "lite_sheds": lite_sheds,
+            "lite_ok_during_brownout": lite_ok_during,
+        }
+        log(f"brownout: level {level_seen}, heavy sheds "
+            f"{heavy_sheds:.0f}, lite sheds {lite_sheds:.0f}, "
+            f"lite ok during {lite_ok_during:.0f}")
+
+        # -- ledger -------------------------------------------------------
+        final = load.finish()
+        results["ledger"] = final
+        results["staging_pool"] = registry.pool.stats()
+        results["registry"] = registry.snapshot()
+        invariants["zero_lost"] = final["lost"] == 0
+        invariants["zero_client_errors"] = final["error"] == 0
+        log(f"ledger: {final}")
+    finally:
+        if load is not None and not load.stop.is_set():
+            load.finish()
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if svc is not None:
+            svc.close()
+
+    ok = all(invariants.values()) and bool(invariants)
+    payload = {
+        "benchmark": "fleet_mux_drill",
+        "torn": False,
+        "config": {
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "split_seconds": split_seconds,
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "wall_seconds": time.monotonic() - t_start,
+        "results": results,
+        "invariants": invariants,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.output:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(os.path.join(_REPO, f"BENCH_mux_{args.record}.json"),
+                  "w") as fh:
+            fh.write(text + "\n")
+    if cleanup and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        log(f"INVARIANT BREACH — work files kept at {workdir}")
+    for name, good in sorted(invariants.items()):
+        log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--smoke", action="store_true",
@@ -686,6 +987,12 @@ def main(argv=None) -> int:
                         "the fault drill: min-size boot, ~10x closed-loop "
                         "ramp, grow/brownout/shrink invariants "
                         "(docs/FLEET.md 'Autoscaling')")
+    p.add_argument("--mux", action="store_true",
+                   help="run the multi-model multiplexing drill instead: "
+                        "weighted split, 1%%->100%% canary ramp with an "
+                        "injected-burn auto-rollback, per-model brownout "
+                        "shed order (docs/MULTIPLEX.md; --record writes "
+                        "BENCH_mux_<TAG>.json)")
     p.add_argument("--max-workers", type=int, default=None,
                    help="autoscale ceiling (default 3; --workers is the "
                         "min, default 1)")
@@ -698,8 +1005,12 @@ def main(argv=None) -> int:
                         "admitted (200) requests")
     args = p.parse_args(argv)
 
+    if args.autoscale and args.mux:
+        p.error("--autoscale and --mux are separate drills")
     if args.autoscale:
         return run_autoscale(args)
+    if args.mux:
+        return run_mux(args)
 
     n_workers = args.workers or (2 if args.smoke else 3)
     total = args.total_steps or (12 if args.smoke else 24)

@@ -10,6 +10,11 @@
 #                                        #   TestTreeIsClean enforces
 #   LINT_BASE=main scripts/lint_gate.sh  # changed vs merge-base with main
 #   LINT_FORMAT=sarif scripts/lint_gate.sh --full > lint.sarif  # CI annotators
+#   scripts/lint_gate.sh --mux           # the serving/mux seam only, with
+#                                        #   the two engine-sharing rules
+#                                        #   (JG016 swap seam, JG022
+#                                        #   cross-generation registry) —
+#                                        #   the fleet_drill --mux preflight
 #
 # Extra arguments pass through to the analyzer (--rules JG00x, --fix, ...).
 # Exit codes are the analyzer's: 0 clean (modulo baseline + suppressions),
@@ -21,6 +26,13 @@ if [ "$1" = "--full" ]; then
   shift
   exec python -m gan_deeplearning4j_tpu.analysis "${TARGETS[@]}" \
     --format "$FORMAT" "$@"
+fi
+if [ "$1" = "--mux" ]; then
+  shift
+  exec python -m gan_deeplearning4j_tpu.analysis \
+    gan_deeplearning4j_tpu/serving gan_deeplearning4j_tpu/deploy \
+    gan_deeplearning4j_tpu/fleet \
+    --rules JG016,JG022 --format "$FORMAT" "$@"
 fi
 exec python -m gan_deeplearning4j_tpu.analysis "${TARGETS[@]}" \
   --changed-only --diff-base "${LINT_BASE:-HEAD}" --format "$FORMAT" "$@"

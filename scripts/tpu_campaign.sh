@@ -131,6 +131,19 @@ if ! JAX_PLATFORMS=cpu timeout 1500 python scripts/fleet_drill.py --smoke \
   echo "$(date +%H:%M:%S) fleet autoscale smoke failed — campaign aborted (see fleet_autoscale_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Mux smoke (CPU, multi-model multiplexing plane, docs/MULTIPLEX.md):
+# refuse to start if weighted splitting, the 1%->100% canary ramp with
+# SLO auto-rollback, or the per-model brownout shed order regressed —
+# two variants behind a 10/90 split with zero lost, an injected burn
+# rolling a ramp back before a clean ramp completes, and the expensive
+# variant shedding first under synthetic overload (enforced by the
+# drill's own exit code). Pinned to CPU so it never touches the chip.
+if ! JAX_PLATFORMS=cpu timeout 1200 python scripts/fleet_drill.py --smoke \
+    --mux \
+    --output artifacts/fleet_mux_smoke.json > fleet_mux_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) fleet mux smoke failed — campaign aborted (see fleet_mux_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

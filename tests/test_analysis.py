@@ -2417,6 +2417,96 @@ class TestUnboundedRespawnLoop:
 
 
 # ===========================================================================
+# JG022 — unguarded cross-generation engine sharing (serving/mux seam)
+# ===========================================================================
+
+class TestCrossGenerationEngineSharing:
+    def test_true_positive_direct_table_subscript(self):
+        # the mux hazard: reading another generation's engine straight
+        # out of the variant table — a concurrent residency-budget
+        # demotion closes that engine's batcher mid-use
+        r = run(
+            "def warm_all(registry):\n"
+            "    registry.variants['gen-12'].engine.warmup()\n"
+        )
+        assert codes(r) == ["JG022"]
+        assert "registry.variants" in r.active[0].message
+        assert "registry lock" in r.active[0].message
+
+    def test_true_positive_iteration_over_table(self):
+        # iterating the live table without the lock: membership itself
+        # is concurrent state (adopt/demote rewrite it)
+        r = run(
+            "class MuxRegistry:\n"
+            "    def kinds(self):\n"
+            "        out = set()\n"
+            "        for v in self._variants.values():\n"
+            "            out.update(v.engine.kinds)\n"
+            "        return out\n"
+        )
+        assert codes(r) == ["JG022"]
+
+    def test_true_positive_wrong_object_lock(self):
+        # holding SOME lock is not holding THE registry's lock: the
+        # base-expression match is exact
+        r = run(
+            "def drain(self, other):\n"
+            "    with self.lock:\n"
+            "        return other.engines['a'].in_flight\n"
+        )
+        assert codes(r) == ["JG022"]
+
+    def test_true_negative_access_under_registry_lock(self):
+        # the corrected idiom the registry's accessors use
+        r = run(
+            "def engine_for(self, name):\n"
+            "    with self.lock:\n"
+            "        return self._variants[name].engine\n"
+            "def route(registry, key):\n"
+            "    with registry.lock:\n"
+            "        return registry.variants[key].batcher\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_init_and_locked_helpers_exempt(self):
+        # __init__ is single-threaded by contract; *_locked helpers run
+        # with the caller already holding the lock (the registry's own
+        # convention)
+        r = run(
+            "class MuxRegistry:\n"
+            "    def __init__(self):\n"
+            "        self._variants = {}\n"
+            "    def _attach_locked(self, name, engine):\n"
+            "        self._variants[name].engine = engine\n"
+            "    def attach(self, name, engine):\n"
+            "        with self.lock:\n"
+            "            self._attach_locked(name, engine)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_nested_def_does_not_inherit_the_lock(self):
+        # a closure defined under the lock may run after the with block
+        # exited (another thread, a callback) — it must take the lock
+        # itself, and the rule must not bless it lexically
+        r = run(
+            "def snapshot(self):\n"
+            "    with self.lock:\n"
+            "        def render():\n"
+            "            return dict(self._variants)\n"
+            "        return render\n"
+        )
+        assert codes(r) == ["JG022"]
+
+    def test_suppression_applies(self):
+        r = run(
+            "def peek(registry):\n"
+            "    return len(registry.variants)  # jaxlint: disable=JG022\n"
+        )
+        assert codes(r) == []
+        assert [f.code for f in r.suppressed] == ["JG022"]
+
+
+# ===========================================================================
 # the project index (phase 1)
 # ===========================================================================
 

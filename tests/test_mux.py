@@ -1,0 +1,950 @@
+"""serving/mux subsystem tests: weighted-splitter determinism and
+minimal-reassignment, the shared staging pool, registry residency budget +
+eviction + re-warm, the continuous canary ramp with auto-rollback,
+per-model brownout tiering, the multi-model service end-to-end over real
+(tiny) engines, the registry-mode reload plane, and the fleet merge's
+model/generation label pass-through (docs/MULTIPLEX.md).
+
+Engine tests reuse the tiny dense graphs the serving suite uses —
+millisecond compiles, identical physics to the MNIST stack."""
+
+import json
+import os
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.nn import (
+    DenseLayer,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.serving import ServingEngine, make_server
+from gan_deeplearning4j_tpu.serving.mux import (
+    BrownoutController,
+    MuxRegistry,
+    MuxService,
+    RampController,
+    SharedStagingPool,
+    WeightedSplitter,
+    health_from_tracker,
+)
+from gan_deeplearning4j_tpu.telemetry.slo import SLOConfig, SLOTracker
+from gan_deeplearning4j_tpu.utils import write_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+Z, FEAT, CLASSES, HIDDEN = 4, 6, 3, 5
+
+
+def tiny_generator(seed=1):
+    b = GraphBuilder(GraphConfig(seed=seed))
+    b.add_inputs("z").set_input_types(InputType.feed_forward(Z))
+    b.add_layer("g_dense_1", DenseLayer(n_out=8), "z")
+    b.add_layer(
+        "g_out", OutputLayer(n_out=FEAT, activation="sigmoid", loss="xent"),
+        "g_dense_1",
+    )
+    b.set_outputs("g_out")
+    return b.build()
+
+
+def tiny_classifier(seed=2):
+    b = GraphBuilder(GraphConfig(seed=seed))
+    b.add_inputs("x").set_input_types(InputType.feed_forward(FEAT))
+    b.add_layer("feat_1", DenseLayer(n_out=HIDDEN), "x")
+    b.add_layer(
+        "cv_out",
+        OutputLayer(n_out=CLASSES, activation="softmax", loss="mcxent"),
+        "feat_1",
+    )
+    b.set_outputs("cv_out")
+    return b.build()
+
+
+def write_bundle(directory, *, gen_seed=1, generation=None):
+    """A serving bundle (gen zip + serving.json) in ``directory``."""
+    os.makedirs(directory, exist_ok=True)
+    gen = tiny_generator(seed=gen_seed)
+    write_model(os.path.join(directory, "gen.zip"), gen, gen.init(),
+                save_updater=False)
+    manifest = {
+        "format_version": 1,
+        "generator": "gen.zip",
+        "generation": generation,
+    }
+    with open(os.path.join(directory, "serving.json"), "w") as fh:
+        json.dump(manifest, fh)
+    return manifest
+
+
+# ===========================================================================
+# weighted splitter — the determinism satellite
+# ===========================================================================
+
+KEYS = [f"user-{i}" for i in range(4000)]
+
+
+class TestWeightedSplitter:
+    def test_same_key_same_variant_across_restarts(self):
+        # the satellite invariant: assignment is a pure function of
+        # (key, weights) — a fresh splitter (a restarted router) agrees
+        # on every key at fixed weights
+        a = WeightedSplitter({"inc": 0.9, "can": 0.1})
+        b = WeightedSplitter({"inc": 0.9, "can": 0.1})
+        assert [a.assign(k) for k in KEYS] == [b.assign(k) for k in KEYS]
+
+    def test_split_is_weight_proportional(self):
+        s = WeightedSplitter({"inc": 0.9, "can": 0.1})
+        got = sum(1 for k in KEYS if s.assign(k) == "can") / len(KEYS)
+        # binomial n=4000 p=0.1: 5 sigma ~ 0.024
+        assert abs(got - 0.1) < 0.03, got
+
+    def test_weight_change_moves_only_the_expected_fraction(self):
+        # the satellite invariant: raising one variant's weight moves
+        # keys ONLY toward it, and in ~the share-delta proportion —
+        # a ramp step disturbs precisely the traffic it admits
+        s = WeightedSplitter({"inc": 0.9, "can": 0.1})
+        before = {k: s.assign(k) for k in KEYS}
+        s.set_weight("can", 0.9)  # share 0.10 -> 0.50
+        after = {k: s.assign(k) for k in KEYS}
+        moved = [k for k in KEYS if before[k] != after[k]]
+        assert all(after[k] == "can" for k in moved)
+        frac = len(moved) / len(KEYS)
+        assert abs(frac - 0.4) < 0.04, frac
+        # and lowering it back restores the original assignment exactly
+        s.set_weight("can", 0.1)
+        assert {k: s.assign(k) for k in KEYS} == before
+
+    def test_three_way_split_and_zero_weight_exclusion(self):
+        s = WeightedSplitter({"a": 0.5, "b": 0.3, "c": 0.2})
+        counts = {"a": 0, "b": 0, "c": 0}
+        for k in KEYS:
+            counts[s.assign(k)] += 1
+        for name, share in (("a", 0.5), ("b", 0.3), ("c", 0.2)):
+            assert abs(counts[name] / len(KEYS) - share) < 0.04
+        s.set_weight("b", 0.0)
+        assert all(s.assign(k) != "b" for k in KEYS[:500])
+
+    def test_among_restricts_candidates(self):
+        s = WeightedSplitter({"a": 0.5, "b": 0.5})
+        assert all(s.assign(k, among=["a"]) == "a" for k in KEYS[:100])
+
+    def test_no_positive_weight_raises(self):
+        s = WeightedSplitter({"a": 0.0})
+        with pytest.raises(LookupError):
+            s.assign("k")
+
+    def test_weight_validation(self):
+        s = WeightedSplitter()
+        with pytest.raises(ValueError):
+            s.set_weight("a", -0.1)
+        with pytest.raises(ValueError):
+            s.set_weight("a", float("nan"))
+
+    def test_shares_normalize(self):
+        s = WeightedSplitter({"a": 3.0, "b": 1.0})
+        assert s.shares() == {"a": 0.75, "b": 0.25}
+
+
+# ===========================================================================
+# shared staging pool
+# ===========================================================================
+
+class TestSharedStagingPool:
+    def test_checkin_checkout_reuses_buffers(self):
+        pool = SharedStagingPool()
+        buf = pool.checkout(8, FEAT)
+        pool.checkin(buf)
+        assert pool.checkout(8, FEAT) is buf
+        assert pool.stats()["allocated_total"] == 1
+
+    def test_pool_is_bounded_per_key(self):
+        pool = SharedStagingPool(per_key_limit=2)
+        bufs = [pool.checkout(8, 4) for _ in range(5)]
+        for b in bufs:
+            pool.checkin(b)
+        assert pool.stats()["pooled"] == 2
+
+    def test_two_engines_share_one_pool(self, tmp_path):
+        # the sub-linear residency claim, concretely: two resident
+        # engines served in turn allocate ONE buffer per (bucket, width)
+        # between them, not one each
+        pool = SharedStagingPool()
+        paths = []
+        for i, seed in enumerate((1, 7)):
+            gen = tiny_generator(seed=seed)
+            p = str(tmp_path / f"g{i}.zip")
+            write_model(p, gen, gen.init(), save_updater=False)
+            paths.append(p)
+        engines = [
+            ServingEngine.from_checkpoints(
+                generator=p, buckets=(4,), export_gauge=False,
+                staging_pool=pool)
+            for p in paths
+        ]
+        z = np.random.default_rng(0).random((3, Z), dtype=np.float32)
+        for _ in range(4):
+            for eng in engines:
+                eng.run("sample", z)
+        assert pool.stats()["allocated_total"] == 1
+        # staged assembly through the shared pool stays bit-exact vs the
+        # host-assembly oracle per engine
+        for eng in engines:
+            np.testing.assert_array_equal(eng.run("sample", z),
+                                          eng.run_host("sample", z))
+
+
+# ===========================================================================
+# registry: residency budget, eviction, re-warm, routing
+# ===========================================================================
+
+class _FakeEngine:
+    """Engine-shaped fake: async dispatch/finalize, no jax."""
+
+    def __init__(self, name, generation=None, fail=False):
+        self.name = name
+        self.generation = generation
+        self.warmed = True
+        self.warm_failed = False
+        self.kinds = ("sample",)
+        self._fail = fail
+
+    def warmup(self, background=False):
+        return {}
+
+    def input_width(self, kind):
+        return Z
+
+    def dispatch(self, kind, rows_list):
+        if self._fail:
+            raise RuntimeError("engine down")
+        return types.SimpleNamespace(
+            lane=0, rows=[np.asarray(r) for r in rows_list])
+
+    def finalize(self, flight):
+        return np.concatenate(flight.rows) * 2.0
+
+
+def fake_registry(budget=2, builds=None, **kw):
+    builds = builds if builds is not None else []
+
+    def build(variant):
+        builds.append(variant.name)
+        return _FakeEngine(variant.name,
+                           generation=variant.generation)
+
+    kw.setdefault("batcher_kwargs",
+                  {"max_latency": 0.0, "default_timeout": 2.0})
+    reg = MuxRegistry(buckets=(1, 8), budget=budget, build=build, **kw)
+    reg._test_builds = builds
+    return reg
+
+
+class TestMuxRegistry:
+    def test_add_routes_and_serves(self):
+        reg = fake_registry()
+        reg.add("a", bundle_path="/a", weight=1.0, generation=3)
+        name, batcher = reg.route("k1")
+        assert name == "a"
+        r = batcher.submit("sample", np.ones((2, Z), np.float32))
+        assert r.ok and r.data.shape == (2, Z)
+        assert reg.variant("a").generation == 3
+        reg.close()
+
+    def test_budget_evicts_least_weighted_to_cold_manifest(self):
+        reg = fake_registry(budget=2)
+        reg.add("heavy", bundle_path="/h", weight=0.9)
+        reg.add("lite", bundle_path="/l", weight=0.1)
+        reg.add("new", bundle_path="/n", weight=0.5)
+        assert sorted(reg.resident_names()) == ["heavy", "new"]
+        lite = reg.variant("lite")
+        assert lite.state == "cold"
+        assert lite.engine is None and lite.batcher is None
+        assert [e["event"] for e in reg.events].count("demote") == 1
+        reg.close()
+
+    def test_demoted_variant_rewarms_on_weight(self):
+        reg = fake_registry(budget=1)
+        reg.add("a", bundle_path="/a", weight=1.0)
+        reg.add("b", bundle_path="/b", weight=0.1)  # evicts a or b
+        builds_before = list(reg._test_builds)
+        cold = [n for n in reg.names() if reg.variant(n).state == "cold"]
+        assert len(cold) == 1
+        # raising the cold variant's weight re-warms it through the
+        # build path (and the budget demotes the other one)
+        reg.set_weight(cold[0], 5.0)
+        assert reg.variant(cold[0]).state == "resident"
+        assert reg._test_builds == builds_before + cold
+        reg.close()
+
+    def test_demote_closes_batcher_and_sheds_cleanly(self):
+        reg = fake_registry(budget=2)
+        reg.add("a", bundle_path="/a", weight=1.0)
+        _, batcher = reg.route("k")
+        assert reg.demote("a") is True
+        # the detached batcher is closed: a straggler submit sheds with
+        # an explicit overloaded result, never hangs or errors
+        r = batcher.submit("sample", np.ones((1, Z), np.float32))
+        assert r.status == "overloaded"
+        assert reg.demote("a") is False  # already cold
+
+    def test_engine_only_variant_is_never_demoted(self):
+        reg = fake_registry(budget=1)
+        reg.add("pinned", engine=_FakeEngine("pinned"), weight=0.1)
+        reg.add("other", bundle_path="/o", weight=9.0)
+        # over budget, but the pinned variant has no cold manifest to
+        # re-warm from — the bundle-backed one is demoted instead even
+        # though it carries more weight... unless it is the newcomer:
+        # the newcomer is protected, so the registry stays over budget
+        assert "pinned" in reg.resident_names()
+        reg.close()
+
+    def test_route_falls_back_past_cold_variants_and_counts(self):
+        reg = fake_registry(budget=2)
+        reg.add("a", bundle_path="/a", weight=1.0)
+        reg.add("b", bundle_path="/b", weight=1.0)
+        reg.add("c", bundle_path="/c", weight=1.0)  # one of them demoted
+        cold = [n for n in reg.names() if reg.variant(n).state == "cold"]
+        assert len(cold) == 1
+        resident = set(reg.resident_names())
+        for i in range(60):
+            name, _ = reg.route(f"k{i}")
+            assert name in resident
+        reg.close()
+
+    def test_adopt_records_event_and_budget_applies(self):
+        reg = fake_registry(budget=1)
+        reg.add("a", bundle_path="/a", weight=1.0)
+        reg.adopt("b", _FakeEngine("b", generation=9), bundle_path="/b")
+        assert [e["event"] for e in reg.events][-1] == "adopt"
+        # newcomer protected; "a" (demotable) was evicted
+        assert reg.resident_names() == ["b"]
+        assert reg.variant("b").generation == 9
+        reg.close()
+
+    def test_duplicate_name_rejected(self):
+        reg = fake_registry()
+        reg.add("a", bundle_path="/a")
+        with pytest.raises(ValueError):
+            reg.add("a", bundle_path="/a2")
+
+    def test_primary_is_highest_weighted_resident(self):
+        reg = fake_registry(budget=3)
+        reg.add("a", bundle_path="/a", weight=0.2)
+        reg.add("b", bundle_path="/b", weight=0.8)
+        assert reg.primary_name() == "b"
+        assert reg.reference_engine().name == "b"
+        assert reg.max_generation() is None
+        reg.close()
+
+    def test_snapshot_shape(self):
+        reg = fake_registry()
+        reg.add("a", bundle_path="/a", weight=1.0, cost=4.0)
+        snap = reg.snapshot()
+        v = snap["variants"]["a"]
+        assert v["resident"] and v["cost"] == 4.0 and v["weight"] == 1.0
+        assert snap["resident"] == 1 and snap["budget"] == 2
+        assert "staging_pool" in snap
+        reg.close()
+
+
+# ===========================================================================
+# ramp controller
+# ===========================================================================
+
+class TestRampController:
+    def _registry(self):
+        reg = fake_registry(budget=8)
+        reg.add("inc", bundle_path="/i", weight=0.9)
+        reg.add("can", bundle_path="/c", weight=0.0)
+        return reg
+
+    def test_walks_stages_and_completes(self):
+        reg = self._registry()
+        ramp = RampController(reg, "can", stages=(0.01, 0.5, 1.0),
+                              hold_ticks=2, health=lambda: True)
+        ramp.start()
+        shares = reg.splitter.shares()
+        assert abs(shares["can"] - 0.01) < 1e-9
+        assert ramp.tick() == "ramping"  # streak 1/2
+        assert ramp.tick() == "ramping"  # advance -> 0.5
+        assert abs(reg.splitter.shares()["can"] - 0.5) < 1e-9
+        ramp.tick()
+        assert ramp.tick() == "ramping"  # advance -> 1.0
+        ramp.tick()
+        assert ramp.tick() == "complete"
+        # completion IS the primary election: candidate takes all traffic
+        assert reg.splitter.shares() == {"can": 1.0}
+        assert reg.primary_name() == "can"
+        reg.close()
+
+    def test_rollback_on_burn_restores_base_weights(self):
+        reg = self._registry()
+        healthy = {"v": True}
+        ramp = RampController(reg, "can", stages=(0.1, 0.5, 1.0),
+                              hold_ticks=1,
+                              health=lambda: healthy["v"])
+        ramp.start()
+        assert ramp.tick() == "ramping"  # -> 0.5
+        healthy["v"] = False
+        assert ramp.tick() == "rolled_back"
+        assert ramp.rollbacks == 1
+        weights = reg.splitter.weights()
+        assert weights["can"] == 0.0
+        assert weights["inc"] == 0.9  # the pre-ramp weight, exactly
+        # a rolled-back ramp is inert
+        assert ramp.tick() == "rolled_back"
+        reg.close()
+
+    def test_no_data_holds_neither_advance_nor_rollback(self):
+        reg = self._registry()
+        ramp = RampController(reg, "can", stages=(0.1, 1.0), hold_ticks=1,
+                              health=lambda: None)
+        ramp.start()
+        for _ in range(5):
+            assert ramp.tick() == "ramping"
+        assert abs(reg.splitter.shares()["can"] - 0.1) < 1e-9
+        assert ramp.rollbacks == 0
+        reg.close()
+
+    def test_health_from_tracker_three_values(self):
+        clock = {"t": 100.0}
+        tracker = SLOTracker(
+            SLOConfig(fast_window_s=10.0, slow_window_s=60.0),
+            clock=lambda: clock["t"],
+            metric_prefix="mux", labels={"model": "can"})
+        health = health_from_tracker(tracker)
+        assert health() is None  # empty windows: no data, hold
+        for _ in range(20):
+            tracker.record(True, 0.01)
+        assert health() is True
+        for _ in range(20):
+            tracker.record(False)
+        assert health() is False
+
+    def test_stage_validation(self):
+        reg = self._registry()
+        with pytest.raises(ValueError):
+            RampController(reg, "can", stages=())
+        with pytest.raises(ValueError):
+            RampController(reg, "can", stages=(0.5, 0.1))
+        with pytest.raises(ValueError):
+            RampController(reg, "can", stages=(0.0, 1.0))
+        with pytest.raises(ValueError):
+            RampController(reg, "can", hold_ticks=0)
+        reg.close()
+
+
+# ===========================================================================
+# per-model brownout tiering
+# ===========================================================================
+
+class TestPerModelBrownout:
+    def _service(self, budget=4):
+        reg = fake_registry(budget=budget)
+        reg.add("heavy", bundle_path="/h", cost=4.0, weight=0.5)
+        reg.add("mid", bundle_path="/m", cost=2.0, weight=0.3)
+        reg.add("lite", bundle_path="/l", cost=1.0, weight=0.2)
+        return MuxService(reg)
+
+    def test_shed_order_is_most_expensive_first(self):
+        svc = self._service()
+        assert svc._shed_set() == set()
+        svc.set_brownout(1)
+        assert svc._shed_set() == {"heavy"}
+        svc.set_brownout(2)
+        assert svc._shed_set() == {"heavy", "mid"}
+        # the cheapest variant NEVER sheds: level clamps at N-1
+        assert svc.set_brownout(99) == 2
+        assert "lite" not in svc._shed_set()
+        svc.close()
+
+    def test_browned_out_variant_sheds_with_honest_503(self):
+        svc = self._service()
+        svc.set_brownout(1)
+        code, body = svc.handle(
+            "POST", "/v1/sample",
+            {"data": [[0.1] * Z], "model": "heavy"})
+        assert code == 503
+        assert body["status"] == "overloaded"
+        assert "brownout" in body["error"] and body["model"] == "heavy"
+        # the cheap variant keeps serving through the brownout
+        code, body = svc.handle(
+            "POST", "/v1/sample",
+            {"data": [[0.1] * Z], "model": "lite"})
+        assert code == 200 and body["model"] == "lite"
+        svc.close()
+
+    def test_sheds_feed_per_model_counters_and_slo(self):
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        svc = self._service()
+        svc.set_brownout(1)
+        for i in range(5):
+            code, _ = svc.handle(
+                "POST", "/v1/sample",
+                {"data": [[0.1] * Z], "model": "heavy"})
+            assert code == 503
+        snap = get_registry().snapshot()
+        series = {
+            tuple(sorted(s["labels"].items())): s["value"]
+            for s in snap["mux_brownout_sheds_total"]["series"]}
+        assert series[(("model", "heavy"),)] == 5.0
+        # the shed IS an availability event for the shed model
+        assert svc.tracker_for("heavy").snapshot()["totals"]["failed"] == 5
+        svc.close()
+
+    def test_controller_hysteresis(self):
+        ctl = BrownoutController(threshold=0.5, enter_ticks=2,
+                                 exit_ticks=2)
+        level = 0
+        assert ctl.tick(0.9, level, 2) == 0    # hot 1/2
+        level = ctl.tick(0.9, level, 2)
+        assert level == 1                       # entered
+        level = ctl.tick(0.9, level, 2)
+        level = ctl.tick(0.9, level, 2)
+        assert level == 2                       # escalated (capped)
+        assert ctl.tick(0.9, level, 2) == 2     # at max: holds
+        assert ctl.tick(0.1, level, 2) == 2     # calm 1/2
+        level = ctl.tick(0.1, level, 2)
+        assert level == 1                       # released one tier
+        assert ctl.tick(float("nan"), level, 2) == 1  # no data: hold
+        ctl2 = BrownoutController()
+        assert ctl2.tick(float("nan"), 0, 2) == 0
+
+    def test_shed_set_ignores_zero_weight_variants(self):
+        # review-caught: ranking by cost alone let a tier shed the ONLY
+        # traffic-carrying variant (a total outage dressed as
+        # degradation) when the cheap siblings carried zero weight —
+        # the shed set must rank WEIGHTED variants only, re-clamped
+        # against the current weights per request
+        reg = fake_registry(budget=4)
+        reg.add("heavy", bundle_path="/h", cost=4.0, weight=1.0)
+        reg.add("adopted", bundle_path="/a", cost=1.0, weight=0.0)
+        svc = MuxService(reg)
+        # one weighted variant: no tier may silence it
+        assert svc.set_brownout(1) == 0
+        assert svc._shed_set() == set()
+        code, body = svc.handle(
+            "POST", "/v1/sample", {"data": [[0.1] * Z], "key": "k"})
+        assert code == 200 and body["model"] == "heavy"
+        # the zero-weight variant gaining weight re-opens the tier —
+        # and a weight change AFTER the level was set re-clamps
+        reg.set_weight("adopted", 1.0)
+        svc.set_brownout(1)
+        assert svc._shed_set() == {"heavy"}
+        reg.set_weight("adopted", 0.0)
+        assert svc._shed_set() == set()
+        svc.close()
+
+    def test_rollback_rewarms_a_budget_evicted_incumbent(self):
+        # review-caught: rollback restored weights with warm=False, so
+        # an incumbent the residency budget evicted mid-ramp stayed
+        # cold-but-weighted forever (every assignment a fallback)
+        reg = fake_registry(budget=2)
+        reg.add("heavy", bundle_path="/h", weight=0.9)
+        reg.add("lite", bundle_path="/l", weight=0.1)
+        reg.adopt("cand", _FakeEngine("cand"), bundle_path="/c")
+        # the adoption evicted the least-weighted incumbent
+        assert reg.variant("lite").state == "cold"
+        ramp = RampController(reg, "cand", stages=(0.5, 1.0),
+                              hold_ticks=1,
+                              health=lambda: False)
+        ramp.start()
+        assert ramp.tick() == "rolled_back"
+        weights = reg.splitter.weights()
+        assert weights == {"heavy": 0.9, "lite": 0.1, "cand": 0.0}
+        # the weighted incumbent came BACK (cand, now weightless and
+        # demotable via its manifest, was evicted in its place)
+        assert reg.variant("lite").state == "resident"
+        reg.close()
+
+    def test_queue_gauge_zeroed_after_demote(self):
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        reg = fake_registry(budget=2)
+        reg.add("a", bundle_path="/a", weight=1.0)
+        svc = MuxService(reg)
+        svc._pressure()
+        reg.demote("a")
+        svc._pressure()
+        snap = get_registry().snapshot()
+        series = {s["labels"]["model"]: s["value"]
+                  for s in snap["mux_queue_depth"]["series"]}
+        assert series["a"] == 0.0
+        svc.close()
+
+    def test_pressure_drives_level_through_control_tick(self):
+        svc = self._service()
+        svc._brownout_auto = BrownoutController(
+            threshold=0.5, enter_ticks=1, exit_ticks=1)
+        # force pressure: shrink a batcher queue and stuff it — simpler
+        # to monkeypatch the pressure reading itself
+        svc._pressure = lambda: 0.9
+        svc.control_tick()
+        assert svc.brownout_level == 1
+        svc._pressure = lambda: 0.0
+        svc.control_tick()
+        assert svc.brownout_level == 0
+        svc.close()
+
+
+# ===========================================================================
+# mux service end-to-end over real engines
+# ===========================================================================
+
+@pytest.fixture(scope="module")
+def bundles(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mux_bundles")
+    out = {}
+    for name, (seed, gen_number) in (
+            ("heavy", (1, 0)), ("lite", (7, 1)), ("cand", (9, 2))):
+        d = str(tmp / name)
+        write_bundle(d, gen_seed=seed, generation=gen_number)
+        out[name] = d
+    return out
+
+
+@pytest.fixture()
+def real_service(bundles):
+    reg = MuxRegistry(
+        buckets=(1, 8), budget=3,
+        batcher_kwargs={"max_latency": 0.001, "default_timeout": 5.0})
+    reg.add("heavy", bundle_path=bundles["heavy"], cost=4.0, weight=0.9)
+    reg.add("lite", bundle_path=bundles["lite"], cost=1.0, weight=0.1)
+    svc = MuxService(reg)
+    yield svc
+    svc.close()
+
+
+class TestMuxServiceEndToEnd:
+    def test_split_serves_zero_lost_and_deterministic(self, real_service):
+        svc = real_service
+        rng = np.random.default_rng(0)
+        first_pass = {}
+        for i in range(120):
+            key = f"user-{i % 40}"  # keys repeat: stickiness observable
+            rows = rng.random((2, Z), dtype=np.float32)
+            code, body = svc.handle(
+                "POST", "/v1/sample", {"data": rows.tolist(), "key": key})
+            assert code == 200, body
+            assert body["status"] == "ok"
+            assert len(body["data"]) == 2
+            assert len(body["data"][0]) == FEAT
+            model = body["model"]
+            assert first_pass.setdefault(key, model) == model
+        # both variants saw traffic at 90/10 over 40 distinct keys —
+        # and the split agrees with the splitter's own assignment
+        expected = {k: svc.registry.splitter.assign(k)
+                    for k in first_pass}
+        assert first_pass == expected
+        assert set(first_pass.values()) == {"heavy", "lite"}
+
+    def test_restart_determinism_at_the_service_level(self, bundles):
+        # the satellite, end-to-end: a REBUILT service (fresh registry,
+        # fresh engines — a restarted worker) routes every key to the
+        # same variant at the same weights
+        def build():
+            reg = MuxRegistry(
+                buckets=(1, 8), budget=2,
+                batcher_kwargs={"max_latency": 0.0,
+                                "default_timeout": 5.0})
+            reg.add("heavy", bundle_path=bundles["heavy"], weight=0.7)
+            reg.add("lite", bundle_path=bundles["lite"], weight=0.3)
+            return MuxService(reg)
+
+        keys = [f"session-{i}" for i in range(30)]
+        row = [[0.5] * Z]
+        assignments = []
+        for _ in range(2):
+            svc = build()
+            got = {}
+            for key in keys:
+                code, body = svc.handle(
+                    "POST", "/v1/sample", {"data": row, "key": key})
+                assert code == 200
+                got[key] = body["model"]
+            assignments.append(got)
+            svc.close()
+        assert assignments[0] == assignments[1]
+
+    def test_metrics_keep_autoscaler_schema(self, real_service):
+        m = real_service.metrics()
+        # the fleet autoscaler's pressure signal reads these exact keys
+        # off any worker — mux or singleton (docs/FLEET.md)
+        assert isinstance(m["queue_depth"], int)
+        assert isinstance(m["pipeline"]["in_flight"], int)
+        assert m["generation"] == 0  # the primary's (heavy) generation
+        assert m["draining"] is False
+        assert set(m["mux"]["per_variant"]) == {"heavy", "lite"}
+
+    def test_healthz_and_mux_status(self, real_service):
+        code, h = real_service.handle("GET", "/healthz")
+        assert code == 200 and h["status"] == "ok"
+        assert h["primary"] == "heavy"
+        assert set(h["variants"]) == {"heavy", "lite"}
+        assert abs(h["shares"]["heavy"] - 0.9) < 1e-9
+        assert h["brownout"]["active"] is False
+        code, s = real_service.handle("GET", "/mux/status")
+        assert code == 200 and s["primary"] == "heavy"
+
+    def test_per_model_series_in_registry(self, real_service):
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        for i in range(4):
+            real_service.handle(
+                "POST", "/v1/sample",
+                {"data": [[0.2] * Z], "model": "lite"})
+        snap = get_registry().snapshot()
+        fam = snap["mux_requests_total"]["series"]
+        lite_ok = [s for s in fam
+                   if s["labels"].get("model") == "lite"
+                   and s["labels"].get("status") == "ok"]
+        assert lite_ok and lite_ok[0]["value"] >= 4.0
+
+    def test_http_round_trip_with_prom_scrape(self, real_service):
+        import urllib.request
+
+        server = make_server(real_service, port=0)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/sample",
+                data=json.dumps(
+                    {"data": [[0.3] * Z], "key": "http-1"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = json.loads(resp.read())
+            assert body["status"] == "ok" and body["model"] in (
+                "heavy", "lite")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics?format=prom",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            assert "mux_requests_total" in text
+            assert 'model="' in text
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=10) as resp:
+                h = json.loads(resp.read())
+            assert h["status"] == "ok"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_bad_requests(self, real_service):
+        svc = real_service
+        assert svc.handle("POST", "/v1/sample", {})[0] == 400
+        assert svc.handle("POST", "/v1/sample",
+                          {"data": [[1.0] * (Z + 1)]})[0] == 400
+        assert svc.handle("POST", "/v1/sample",
+                          {"data": [[1.0] * Z], "key": 7})[0] == 400
+        assert svc.handle("POST", "/v1/nope",
+                          {"data": [[1.0] * Z]})[0] == 404
+        assert svc.handle("POST", "/v1/sample",
+                          {"data": [[1.0] * Z],
+                           "model": "ghost"})[0] == 404
+        assert svc.handle("GET", "/nope", None)[0] == 404
+
+    def test_ramp_over_real_engines(self, real_service, bundles):
+        svc = real_service
+        svc.registry.add("cand", bundle_path=bundles["cand"], cost=1.0,
+                         weight=0.0)
+        ramp = svc.start_ramp("cand", stages=(0.5, 1.0), hold_ticks=1,
+                              health=lambda: True)
+        assert svc.registry.variant("cand").state == "resident"
+        code, body = svc.handle(
+            "POST", "/v1/sample", {"data": [[0.4] * Z], "model": "cand"})
+        assert code == 200
+        ramp.tick()
+        ramp.tick()
+        assert ramp.state == "complete"
+        assert svc.registry.primary_name() == "cand"
+
+
+# ===========================================================================
+# the reload plane feeds the registry (registry-mode ReloadController)
+# ===========================================================================
+
+class TestReloadFeedsRegistry:
+    def test_adopts_candidates_instead_of_swapping(self, tmp_path):
+        from gan_deeplearning4j_tpu.deploy import ReloadController
+        from gan_deeplearning4j_tpu.deploy.watcher import StoreWatcher
+        from gan_deeplearning4j_tpu.resilience import CheckpointStore
+
+        store = CheckpointStore(str(tmp_path / "store"))
+
+        def publish(seed):
+            number = store.next_number()
+            return store.publish(
+                lambda d: write_bundle(d, gen_seed=seed,
+                                       generation=number),
+                step=number, extra={"kind": "serving"})
+
+        g0 = publish(1)
+        reg = MuxRegistry(
+            buckets=(1, 4), budget=2,
+            batcher_kwargs={"max_latency": 0.0, "default_timeout": 5.0})
+        ctl = ReloadController(
+            None, StoreWatcher(store=store), registry=reg,
+            adopt_cost=2.0)
+        # bootstrap: the first valid generation is adopted ungated (no
+        # incumbent to compare against), resident at weight 0
+        status = ctl.poll_now()
+        assert status["mode"] == "registry"
+        assert status["adopted"] == 1
+        name0 = f"gen-{g0.number}"
+        assert reg.names() == [name0]
+        assert reg.variant(name0).state == "resident"
+        assert reg.splitter.weights()[name0] == 0.0
+        assert reg.variant(name0).cost == 2.0
+        # a newer generation is adopted as a SECOND variant — nothing
+        # swapped, nothing drained, the incumbent untouched
+        reg.set_weight(name0, 1.0)
+        g1 = publish(5)
+        ctl.poll_now()
+        name1 = f"gen-{g1.number}"
+        assert sorted(reg.names()) == sorted([name0, name1])
+        assert reg.variant(name1).state == "resident"
+        assert reg.primary_name() == name0  # weight still rules
+        assert ctl.status()["adopted"] == 2
+        # nothing newer: idle cycle
+        assert ctl.poll_now()["state"] == "idle"
+        assert ctl.status()["adopted"] == 2
+        reg.close()
+
+    def test_candidate_dropping_kinds_rejected_not_adopted(self, tmp_path):
+        from gan_deeplearning4j_tpu.deploy import ReloadController
+        from gan_deeplearning4j_tpu.deploy.watcher import StoreWatcher
+        from gan_deeplearning4j_tpu.resilience import CheckpointStore
+
+        store = CheckpointStore(str(tmp_path / "store"))
+
+        def publish(writer):
+            number = store.next_number()
+            return store.publish(writer, step=number,
+                                 extra={"kind": "serving"})
+
+        publish(lambda d: _full_bundle(d, generation=store.next_number()))
+        reg = MuxRegistry(
+            buckets=(1, 4), budget=2,
+            batcher_kwargs={"max_latency": 0.0, "default_timeout": 5.0})
+        ctl = ReloadController(None, StoreWatcher(store=store),
+                               registry=reg)
+        ctl.poll_now()
+        assert len(reg.names()) == 1
+        reg.set_weight(reg.names()[0], 1.0)
+        # generator-only candidate drops the classify kind the primary
+        # serves: config mismatch — rejected, never adopted
+        publish(lambda d: write_bundle(d, gen_seed=3,
+                                       generation=store.next_number()))
+        ctl.poll_now()
+        assert len(reg.names()) == 1
+        assert ctl.status()["rejected"] == 1
+        reg.close()
+
+
+def _full_bundle(directory, *, generation):
+    """Bundle with generator AND classifier (both kinds served)."""
+    os.makedirs(directory, exist_ok=True)
+    gen, cv = tiny_generator(seed=2), tiny_classifier(seed=4)
+    write_model(os.path.join(directory, "gen.zip"), gen, gen.init(),
+                save_updater=False)
+    write_model(os.path.join(directory, "cv.zip"), cv, cv.init(),
+                save_updater=False)
+    with open(os.path.join(directory, "serving.json"), "w") as fh:
+        json.dump({"format_version": 1, "generator": "gen.zip",
+                   "classifier": "cv.zip", "feature_vertex": "feat_1",
+                   "generation": generation}, fh)
+
+
+# ===========================================================================
+# the drill (slow — the campaign gate's shape)
+# ===========================================================================
+
+@pytest.mark.slow
+def test_mux_drill_smoke(tmp_path):
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleet_drill.py"),
+         "--smoke", "--mux", "--workdir", str(tmp_path / "work"),
+         "--output", str(tmp_path / "mux.json")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "GDT_COMPILATION_CACHE": "off"},
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    with open(tmp_path / "mux.json") as fh:
+        payload = json.load(fh)
+    assert payload["ok"] is True
+    assert payload["invariants"]["zero_lost"]
+    assert payload["invariants"]["brownout_sheds_expensive_first"]
+
+
+# ===========================================================================
+# fleet merge: the model/generation label pass-through satellite
+# ===========================================================================
+
+class TestMergeMemberLabels:
+    def test_generation_label_keeps_per_model_series_apart(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import (
+            merge_snapshots,
+        )
+
+        def worker_snap(n):
+            return {"serve_requests_total": {
+                "type": "counter", "help": "",
+                "series": [{"labels": {"kind": "sample", "status": "ok"},
+                            "value": float(n)}]}}
+
+        # WITHOUT the member labels the two workers' series collapse
+        merged = merge_snapshots({"w0": worker_snap(10),
+                                  "w1": worker_snap(3)})
+        series = merged["serve_requests_total"]["series"]
+        assert len(series) == 1 and series[0]["value"] == 13.0
+        # WITH them, one series per generation — per-model truth kept
+        merged = merge_snapshots(
+            {"w0": worker_snap(10), "w1": worker_snap(3)},
+            member_labels={"w0": {"generation": "4"},
+                           "w1": {"generation": "7"}})
+        series = {s["labels"]["generation"]: s["value"]
+                  for s in merged["serve_requests_total"]["series"]}
+        assert series == {"4": 10.0, "7": 3.0}
+
+    def test_member_labels_never_override_series_labels(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import (
+            merge_snapshots,
+        )
+
+        snap = {"mux_requests_total": {
+            "type": "counter", "help": "",
+            "series": [{"labels": {"model": "lite"}, "value": 2.0}]}}
+        merged = merge_snapshots(
+            {"w0": snap}, member_labels={"w0": {"model": "WRONG",
+                                                "generation": "9"}})
+        s = merged["mux_requests_total"]["series"][0]
+        assert s["labels"]["model"] == "lite"  # the worker's label wins
+        assert s["labels"]["generation"] == "9"
+
+    def test_gauges_get_member_labels_and_worker(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import (
+            merge_snapshots,
+        )
+
+        snap = {"serve_queue_depth": {
+            "type": "gauge", "help": "",
+            "series": [{"labels": {}, "value": 3.0}]}}
+        merged = merge_snapshots(
+            {"w0": snap}, member_labels={"w0": {"generation": "4"}})
+        s = merged["serve_queue_depth"]["series"][0]
+        assert s["labels"] == {"generation": "4", "worker": "w0"}
