@@ -50,6 +50,9 @@ from gan_deeplearning4j_tpu.analysis.rules.respawn import (
 from gan_deeplearning4j_tpu.analysis.rules.mux_sharing import (
     CrossGenerationEngineSharing,
 )
+from gan_deeplearning4j_tpu.analysis.rules.alert_metrics import (
+    UnknownMetricInAlertRule,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -74,6 +77,7 @@ RULES = [
     SyncHostIoOnStepPath(),
     UnboundedRespawnLoop(),
     CrossGenerationEngineSharing(),
+    UnknownMetricInAlertRule(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

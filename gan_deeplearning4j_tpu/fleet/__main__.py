@@ -128,6 +128,21 @@ def main(argv=None) -> int:
                         "on workers that die before becoming routable")
     p.add_argument("--spawn-backoff-max", type=float, default=30.0,
                    help="backoff cap for repeated spawn failures")
+    p.add_argument("--alerts", action="store_true",
+                   help="enable the alerting plane (telemetry/alerts.py, "
+                        "default fleet rule pack): GET /alerts, healthz "
+                        "alerts block, exemplar capture; evaluation rides "
+                        "the health loop, no extra scrape")
+    p.add_argument("--alert-stale-after", type=float, default=10.0,
+                   help="scrape_stale rule: seconds since a member's last "
+                        "successful /metrics scrape before it alerts")
+    p.add_argument("--alert-latency-drift", type=float, default=0.05,
+                   help="latency_anomaly rule: smallest p99 drift (s) "
+                        "worth a robust-z unit — a shift of ~12x this "
+                        "over the rolling baseline pages")
+    p.add_argument("--alert-webhook", default=None, metavar="URL",
+                   help="POST every alert transition to this URL (bounded "
+                        "timeout + retries, off the evaluation path)")
     args = p.parse_args(argv)
 
     logging.basicConfig(
@@ -217,6 +232,24 @@ def main(argv=None) -> int:
         spawn_backoff_base=args.spawn_backoff,
         spawn_backoff_max=args.spawn_backoff_max,
     )
+    if args.alerts:
+        from gan_deeplearning4j_tpu.telemetry.alerts import (
+            AlertManager,
+            WebhookSink,
+            default_fleet_rules,
+            log_sink,
+        )
+
+        sinks = [log_sink]
+        if args.alert_webhook:
+            sinks.append(WebhookSink(args.alert_webhook))
+        router.attach_alerts(AlertManager(
+            default_fleet_rules(
+                probe_interval_s=args.probe_interval,
+                scrape_stale_after_s=args.alert_stale_after,
+                latency_drift_floor_s=args.alert_latency_drift,
+                annotate_member=router.annotate_member),
+            sinks=tuple(sinks)))
     log = logging.getLogger(__name__)
     # bind the router port BEFORE spawning workers: a bind failure must
     # not leave N orphaned worker subprocesses behind

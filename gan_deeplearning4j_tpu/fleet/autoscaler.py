@@ -180,20 +180,17 @@ class Autoscaler:
         contract on a dead router."""
         router = self.manager.router
         try:
-            burn = router.slo.burn_rates()
-            queue = inflight = 0
-            routable = 0
-            for ref in router.workers():
-                snap = ref.snapshot()
-                if snap["routable"]:
-                    routable += 1
-                queue += int(snap.get("queue_depth") or 0)
-                inflight += int(snap.get("inflight") or 0)
+            # router.member_signals() is THE shared signal seam: one
+            # pass over the health loop's scraped worker state, also
+            # feeding the fleet_member_* gauges the alert plane and the
+            # prom surface read — autoscaling and alerting pay for the
+            # same scrape exactly once
+            signals = router.member_signals()
             return {
-                "routable": routable,
-                "queue_depth": queue,
-                "in_flight": inflight,
-                "burn_rates": burn,
+                "routable": signals["routable"],
+                "queue_depth": signals["queue_depth"],
+                "in_flight": signals["in_flight"],
+                "burn_rates": router.slo.burn_rates(),
             }
         except Exception:
             logger.exception("autoscaler scrape failed")

@@ -287,6 +287,28 @@ class FleetRouter:
             "fleet_brownout_deadline_clamps_total",
             "admitted requests whose effective deadline was capped by "
             "tier-2 brownout")
+        # per-member liveness/staleness as REAL gauge families (not just
+        # /healthz JSON): what the prom surface scrapes and the alert
+        # rules evaluate. Families cost nothing until labeled; the
+        # health loop materializes one series per registered member and
+        # remove_worker drops it (a retired member is not a down member)
+        self._g_member_routable = registry.gauge(
+            "fleet_member_routable",
+            "1 when the member is in the routable pool, 0 when ejected, "
+            "draining, or dead",
+            labelnames=("worker",))
+        self._g_member_scrape_age = registry.gauge(
+            "fleet_member_scrape_age_seconds",
+            "age of the member's last successful /metrics scrape "
+            "(NaN until the first lands)",
+            labelnames=("worker",))
+        # alert plane (telemetry/alerts.py) — None until attach_alerts;
+        # disabled it allocates zero series and zero per-request work
+        # (the PR 6 telemetry-off contract)
+        self.alerts = None
+        self._exemplars = None
+        self._h_latency: Optional[object] = None
+        self._g_pressure: Optional[object] = None
         # SLO burn-rate tracking over every routed outcome — the healthz
         # block and the admission signal (telemetry/slo.py)
         self.slo = SLOTracker(slo_config)
@@ -303,6 +325,10 @@ class FleetRouter:
     def remove_worker(self, worker_id: str) -> None:
         with self._lock:
             self._workers.pop(worker_id, None)
+        # a retired member must not linger as a zero-valued "down" fact
+        # (the worker_down rule would page forever on a scale-down)
+        self._g_member_routable.remove(worker=worker_id)
+        self._g_member_scrape_age.remove(worker=worker_id)
 
     def worker(self, worker_id: str) -> WorkerRef:
         with self._lock:
@@ -456,6 +482,19 @@ class FleetRouter:
             latency = time.perf_counter() - t0
             self.slo.record(status < 500,
                             latency if status < 500 else None)
+            if self._exemplars is not None:
+                # evidence for the alert plane: the trace ids of concrete
+                # requests that crossed a bad threshold, linkable into
+                # the merged GET /debug/trace chain
+                if status >= 500:
+                    self._exemplars.record("availability", tid,
+                                           status=status)
+                else:
+                    self._h_latency.observe(latency)
+                    if latency > self.slo.config.latency_threshold_s:
+                        self._exemplars.record(
+                            "latency", tid, status=status,
+                            latency_ms=round(latency * 1e3, 3))
 
     def _route(self, method: str, path: str, body: Optional[bytes]
                ) -> Tuple[int, bytes]:
@@ -518,6 +557,14 @@ class FleetRouter:
                 # connection-level failure: the worker is gone or hung —
                 # passive ejection signal, retryable on another worker
                 retryable = f"{type(exc).__name__}: {exc}"
+                if self._exemplars is not None:
+                    # ref.pid is still the DEAD process here (the manager
+                    # rebinds it at relaunch): the worker_down alert's
+                    # exemplars name the pid that actually failed
+                    self._exemplars.record(
+                        "worker_failure", current_trace_id(),
+                        worker=ref.id, pid=ref.pid,
+                        error=type(exc).__name__)
                 ref.count("failed")
                 if ref.breaker.record(False) == "tripped":
                     self._note_ejection(ref, retryable)
@@ -600,6 +647,15 @@ class FleetRouter:
                 # proxied-failure streak washed out by scrape successes
                 ref.update_scrape(metrics)
         self._g_routable.set(sum(1 for w in self.workers() if w.routable))
+        if self.alerts is not None:
+            # the evaluation tick rides the sweep this loop already ran —
+            # alerting shares the scrape, it never adds one
+            try:
+                self.alerts.evaluate(self.alert_view())
+            except Exception:
+                logger.exception("alert evaluation failed")
+        else:
+            self.member_signals()  # keep the member gauges fresh anyway
 
     def _health_loop(self) -> None:
         while not self._stop.is_set():
@@ -608,6 +664,88 @@ class FleetRouter:
             except Exception:  # a probe bug must not kill the loop
                 logger.exception("health pass failed")
             self._stop.wait(self.probe_interval)
+
+    # -- the alert plane (telemetry/alerts.py) ----------------------------
+    def attach_alerts(self, manager) -> None:
+        """Attach an :class:`~...telemetry.alerts.AlertManager`: the
+        health loop ticks its evaluation over :meth:`alert_view`, the
+        request path starts feeding the latency histogram and exemplar
+        store, and ``GET /alerts`` + the ``/healthz`` block go live.
+        Never attached, none of those series or ring buffers exist."""
+        registry = get_registry()
+        self._h_latency = registry.histogram(
+            "fleet_request_latency_seconds",
+            "client-visible latency of answered (non-5xx) routed "
+            "requests — the latency-anomaly rule's input (bounded "
+            "samples, so the p99 tracks recent behavior)",
+            max_samples=512)
+        self._g_pressure = registry.gauge(
+            "fleet_pressure",
+            "queue+in-flight per routable worker (NaN when none is "
+            "routable — fail closed)")
+        self._exemplars = manager.exemplars
+        self.alerts = manager
+
+    def annotate_member(self, labels: dict) -> dict:
+        """Annotation hook for member-scoped rules: worker id -> the
+        facts an operator reaches for first (pid, url, breaker state)."""
+        try:
+            ref = self.worker(str(labels.get("worker")))
+        except KeyError:
+            return {}
+        return {"pid": ref.pid, "base_url": ref.base_url,
+                "breaker": ref.breaker.snapshot().get("state")}
+
+    def member_signals(self) -> dict:
+        """One pass over the health loop's already-scraped worker state:
+        routable/queue/in-flight totals plus per-member staleness — and
+        the refresh of the ``fleet_member_*`` (and, with the alert plane
+        attached, ``fleet_pressure``) gauges. THE shared seam: the
+        autoscaler's tick and the alert evaluator both read this instead
+        of paying a second per-worker HTTP fan-out."""
+        routable = queue = inflight = 0
+        ages: Dict[str, Optional[float]] = {}
+        for ref in self.workers():
+            snap = ref.snapshot()
+            up = bool(snap["routable"])
+            if up:
+                routable += 1
+            queue += int(snap.get("queue_depth") or 0)
+            inflight += int(snap.get("inflight") or 0)
+            age = snap.get("last_scrape_age_s")
+            ages[ref.id] = age
+            self._g_member_routable.labels(worker=ref.id).set(
+                1.0 if up else 0.0)
+            self._g_member_scrape_age.labels(worker=ref.id).set(
+                float("nan") if age is None else float(age))
+        # reconcile: a tick racing remove_worker can re-create a retired
+        # member's series AFTER the removal (list snapshotted above) —
+        # and with the ref gone, nothing would ever touch it again, so
+        # worker_down would page forever on a scale-down. Prune any
+        # series whose member is no longer registered.
+        for fam in (self._g_member_routable, self._g_member_scrape_age):
+            for labels, _ in fam.series():
+                if labels.get("worker") not in ages:
+                    fam.remove(**labels)
+        if self._g_pressure is not None:
+            self._g_pressure.set(
+                ((queue + inflight) / routable) if routable
+                else float("nan"))
+        return {"routable": routable, "queue_depth": queue,
+                "in_flight": inflight, "scrape_age_s": ages}
+
+    def alert_view(self) -> dict:
+        """The alert evaluator's input: the same snapshot-shaped payload
+        ``GET /metrics?scope=fleet`` is built from, assembled purely
+        from signals already in this process (the router/manager/
+        autoscaler registry plus the health loop's member scrapes,
+        refreshed through :meth:`member_signals`) — evaluation adds no
+        second per-worker HTTP fan-out. The evaluator is snapshot-shape
+        generic, so it also consumes an actual merged fleet snapshot
+        unchanged (tested)."""
+        self.slo.snapshot()  # refresh the burn-rate gauges
+        self.member_signals()
+        return get_registry().snapshot(include_samples=True)
 
     # -- fleet-scale observability ---------------------------------------
     def fleet_metrics_snapshot(self) -> dict:
@@ -698,6 +836,10 @@ class FleetRouter:
             # upgrade gate read slo["ok"]
             "slo": self.slo.snapshot(),
         }
+        if self.alerts is not None:
+            # the compact "is anything firing" block; GET /alerts has the
+            # full instances/exemplars/incidents payload
+            body["alerts"] = self.alerts.health_block()
         if self.manager is not None:
             body["fleet"] = self.manager.status()
         return body
@@ -767,6 +909,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # /debug/spans) as one Perfetto-loadable document
                 self._respond(200,
                               json.dumps(self.router.fleet_trace()).encode())
+            elif route == "/alerts":
+                if self.router.alerts is None:
+                    self._respond(404, _json_body(
+                        "error", "no alert plane (start with --alerts)"))
+                elif "prom" in params.get("format", []):
+                    self._respond(
+                        200, self.router.alerts.to_prometheus().encode(),
+                        content_type="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                else:
+                    self._respond(200, json.dumps(json_sanitize(
+                        self.router.alerts.snapshot())).encode())
             else:
                 self._respond(404, _json_body("error",
                                               f"no route GET {route}"))

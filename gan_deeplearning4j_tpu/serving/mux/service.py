@@ -108,8 +108,18 @@ class MuxService:
 
     def __init__(self, registry: MuxRegistry, *,
                  slo_config: Optional[SLOConfig] = None,
-                 brownout: Optional[BrownoutController] = None):
+                 brownout: Optional[BrownoutController] = None,
+                 alerts=None):
+        """``alerts`` is an optional
+        :class:`~...telemetry.alerts.AlertManager` — typically over
+        :func:`~...telemetry.alerts.default_mux_rules`, whose burn and
+        queue rules read the per-model labeled families and therefore
+        fan out into one alert instance per variant (per-model scoping;
+        docs/MULTIPLEX.md "Alerting"). The control loop ticks its
+        evaluation over this process's registry snapshot; ``GET
+        /alerts`` serves it. None = zero alerting cost."""
         self.registry = registry
+        self.alerts = alerts
         self.draining = False
         self._slo_config = slo_config
         self._lock = threading.Lock()
@@ -264,6 +274,21 @@ class MuxService:
             pressure, self.brownout_level, self._max_level())
         if level != self.brownout_level:
             self.set_brownout(level)
+        if self.alerts is not None:
+            # per-model alerting rides the control tick the service
+            # already runs — same no-extra-scrape contract as the fleet
+            # plane (the per-model families are in THIS registry). The
+            # burn-rate gauges only move when a tracker snapshots, so
+            # refresh every variant's stream first.
+            try:
+                with self._lock:
+                    trackers = list(self._trackers.values())
+                for tracker in trackers:
+                    tracker.snapshot()
+                self.alerts.evaluate(
+                    get_registry().snapshot(include_samples=True))
+            except Exception:
+                logger.exception("mux alert evaluation failed")
 
     def start_control_loop(self, interval: float = 0.25) -> threading.Thread:
         with self._lock:
@@ -305,7 +330,7 @@ class MuxService:
         level = self.brownout_level
         primary = self.registry.primary_name()
         ramp = self.ramp
-        return {
+        body = {
             "status": status,
             "role": "mux",
             "kinds": sorted(kinds),
@@ -320,6 +345,9 @@ class MuxService:
             "slo": {name: tracker.snapshot()
                     for name, tracker in sorted(self._trackers.items())},
         }
+        if self.alerts is not None:
+            body["alerts"] = self.alerts.health_block()
+        return body
 
     def metrics(self) -> dict:
         """Aggregate + per-variant metrics. Top-level ``queue_depth`` /
@@ -513,6 +541,11 @@ class MuxService:
             return 200, self.metrics()
         if method == "GET" and path == "/mux/status":
             return 200, self.healthz()
+        if method == "GET" and path == "/alerts":
+            if self.alerts is None:
+                return 404, {"status": "error",
+                             "error": "no alert plane attached"}
+            return 200, self.alerts.snapshot()
         if method == "GET" and path == "/debug/spans":
             return 200, TRACER.chrome_trace(
                 {"source": "gan_deeplearning4j_tpu.serving.mux"})

@@ -23,6 +23,11 @@ Two fleet-scale layers sit on that substrate (docs/OBSERVABILITY.md
   and ``GET /debug/trace``.
 - :mod:`.slo` — multi-window availability/latency burn rates over
   router-observed outcomes; empty windows fail closed.
+- :mod:`.alerts` — the declarative alerting & anomaly-detection plane
+  over those snapshots: rule kinds threshold/absence/burn/anomaly, a
+  pending/firing/resolved lifecycle with per-direction hysteresis,
+  trace exemplars, and pluggable sinks (docs/OBSERVABILITY.md
+  "Alerting").
 
 The package namespace is LAZY (PEP 562) like the project root: importing
 it must not import jax — ``registry``/``trace`` are stdlib-only and the
@@ -63,6 +68,18 @@ _LAZY_EXPORTS = {
                      "merge_traces"),
     "SLOConfig": ("gan_deeplearning4j_tpu.telemetry.slo", "SLOConfig"),
     "SLOTracker": ("gan_deeplearning4j_tpu.telemetry.slo", "SLOTracker"),
+    "AlertRule": ("gan_deeplearning4j_tpu.telemetry.alerts", "AlertRule"),
+    "AlertManager": ("gan_deeplearning4j_tpu.telemetry.alerts",
+                     "AlertManager"),
+    "ExemplarStore": ("gan_deeplearning4j_tpu.telemetry.alerts",
+                      "ExemplarStore"),
+    "WebhookSink": ("gan_deeplearning4j_tpu.telemetry.alerts",
+                    "WebhookSink"),
+    "log_sink": ("gan_deeplearning4j_tpu.telemetry.alerts", "log_sink"),
+    "default_fleet_rules": ("gan_deeplearning4j_tpu.telemetry.alerts",
+                            "default_fleet_rules"),
+    "default_mux_rules": ("gan_deeplearning4j_tpu.telemetry.alerts",
+                          "default_mux_rules"),
     "capture_device_trace": ("gan_deeplearning4j_tpu.telemetry.device",
                              "capture_device_trace"),
     "capture_async": ("gan_deeplearning4j_tpu.telemetry.device",

@@ -111,8 +111,13 @@ def merge_snapshots(parts: Dict[str, dict],
                     # never override a label the series already carries
                     labels.setdefault(str(k), str(v))
                 if kind == "gauge":
-                    # one fact per member: label, don't sum
-                    labels["worker"] = member
+                    # one fact per member: label, don't sum. setdefault —
+                    # a series that already names the member it describes
+                    # (the router's per-worker fleet_member_* gauges)
+                    # keeps its own worker label; overriding it with the
+                    # CONTRIBUTING member would relabel every fact as a
+                    # fact about the router process
+                    labels.setdefault("worker", member)
                 key = _label_key(labels)
                 slot = merged["series"].get(key)
                 if slot is None:

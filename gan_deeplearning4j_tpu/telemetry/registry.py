@@ -178,6 +178,15 @@ class _Family:
     def observe(self, value: float) -> None:
         self._solo().observe(value)
 
+    def remove(self, **kv) -> bool:
+        """Drop one labeled series. Gauges describe facts about things
+        that can stop existing (a retired fleet member): without removal
+        the series would linger at its last value forever and read as a
+        live fact to every scrape and alert rule."""
+        key = _check_labels(self.labelnames, kv)
+        with self._lock:
+            return self._series.pop(key, None) is not None
+
     def series(self) -> List[Tuple[Dict[str, str], object]]:
         with self._lock:
             return [

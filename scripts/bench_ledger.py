@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""bench_ledger — fold the repo's BENCH_*.json trajectory into one table.
+
+Every drill and bench in this repo records a ``BENCH_<family>_<round>.json``
+at the repo root (serve_bench, resilience_drill, reload_drill,
+fleet_drill and its --autoscale/--mux/--alerts phases,
+update_sharding_bench). Each file was a gate when it was recorded — and
+then became archaeology: nothing machine-reads the *trajectory*, so a
+regression between rounds is caught by a human eyeballing JSON diffs, if
+at all (the ROADMAP's "TPU-measured truth" item). This script is the
+machine gate:
+
+1. **trend table** — group the records by family, extract each family's
+   key metrics (the spec below names them), and print one row per
+   (family, round) with the delta vs the family's baseline round.
+2. **regression gate** — for direction-annotated metrics, compare the
+   NEWEST round against the baseline round under a per-metric relative
+   tolerance; exit nonzero when any metric regressed past it, when a
+   hard bound (``max_abs`` — e.g. lost requests must be 0) is breached,
+   or when the newest record of a family carries ``ok: false`` /
+   a false invariant. Single-record families gate on their own
+   invariants only (no delta exists yet).
+
+``scripts/tpu_campaign.sh`` runs this as a post-step after a campaign's
+steps land, so a chip session that quietly regressed a recorded metric
+fails the campaign instead of shipping a worse number as the new normal.
+
+Stdlib-only; works in jax-free containers.
+
+Usage::
+
+    python scripts/bench_ledger.py                  # table + gate
+    python scripts/bench_ledger.py --json out.json  # also machine-readable
+    python scripts/bench_ledger.py --baseline r01   # pin the compare round
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: BENCH_<family>_<round>.json; bare BENCH_<round>.json is the training
+#: bench harness's raw dump family ("train")
+_NAME_RE = re.compile(r"^BENCH_(?:(?P<family>.+)_)?(?P<round>r\d+)\.json$")
+
+
+def _get(doc: dict, dotted: str):
+    node = doc
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+class Metric:
+    """One tracked number: where it lives, which direction is better,
+    and how much relative movement the gate tolerates."""
+
+    def __init__(self, label: str, paths, direction: str = "info",
+                 tolerance: float = 0.25, max_abs=None):
+        self.label = label
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self.direction = direction  # "higher" | "lower" | "info"
+        self.tolerance = tolerance
+        self.max_abs = max_abs
+
+    def extract(self, doc: dict):
+        for path in self.paths:
+            value = _get(doc, path)
+            if isinstance(value, (int, float)) and not isinstance(
+                    value, bool):
+                return float(value)
+        return None
+
+
+#: the per-family key-metric spec. "info" metrics land in the table but
+#: never gate; hard bounds (max_abs) gate on every record's newest round.
+SPEC = {
+    "serving": [
+        Metric("throughput_rps", "results.throughput_rps",
+               "higher", 0.30),
+        Metric("p99_batch_ms", "results.latency_ms.sample.p99", "info"),
+        Metric("lost", "results.lost", "lower", 0.0, max_abs=0),
+    ],
+    "resilience": [
+        Metric("ckpt_overhead_frac",
+               "results.oracle.checkpoint_overhead_frac", "lower", 0.35),
+        Metric("relaunches", "results.kill_recover.relaunches", "info"),
+    ],
+    "resilience_mh": [
+        Metric("lost_steps", "results.lost_steps", "info"),
+        Metric("recovery_wall_s",
+               ["results.recovery_wall_s", "results.recovery.wall_s"],
+               "info"),
+    ],
+    "reload": [
+        Metric("swaps", "results.swap_phase.swaps_observed", "info"),
+        Metric("lost", "results.requests.lost", "lower", 0.0, max_abs=0),
+    ],
+    "fleet": [
+        Metric("answered", "results.requests.ok", "info"),
+        Metric("lost", "results.requests.lost", "lower", 0.0, max_abs=0),
+        Metric("errors", "results.requests.error", "lower", 0.0,
+               max_abs=0),
+    ],
+    "autoscale": [
+        Metric("p99_s", "results.latency.p99_s", "lower", 1.0),
+        Metric("lost", "results.requests.lost", "lower", 0.0, max_abs=0),
+    ],
+    "mux": [
+        Metric("lite_share", "results.split.lite_share_observed", "info"),
+        Metric("lost", "results.ledger.lost", "lower", 0.0, max_abs=0),
+    ],
+    "update_sharding": [
+        Metric("resident_ratio_m2",
+               ["results.mesh_2.resident_ratio",
+                "results.resident_ratio_mesh2"], "info"),
+    ],
+    "alerts": [
+        Metric("lost", "results.requests.lost", "lower", 0.0, max_abs=0),
+        Metric("false_fires", "results.false_fires", "lower", 0.0,
+               max_abs=0),
+    ],
+    "train": [],  # raw bench dumps: invariants/ok gating only
+}
+
+
+def _ok_flag(doc: dict):
+    """The record's own verdict: an explicit ``ok`` bool, else all
+    invariants true, else None (no verdict recorded)."""
+    ok = doc.get("ok")
+    if isinstance(ok, bool):
+        return ok
+    invariants = doc.get("invariants")
+    if isinstance(invariants, dict) and invariants:
+        return all(bool(v) for v in invariants.values())
+    return None
+
+
+def collect(root: str) -> dict:
+    """{family: [(round, path, doc)]} sorted by round."""
+    families: dict = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_*.json"))):
+        m = _NAME_RE.match(os.path.basename(path))
+        if not m:
+            continue
+        family = m.group("family") or "train"
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"bench_ledger: {path}: unreadable ({exc})",
+                  file=sys.stderr)
+            doc = {}
+        families.setdefault(family, []).append(
+            (m.group("round"), os.path.basename(path), doc))
+    for rounds in families.values():
+        rounds.sort(key=lambda item: int(item[0][1:]))
+    return families
+
+
+def build_ledger(families: dict, baseline_round: str = None) -> dict:
+    """The full trend + gate payload; ``regressions`` drives the exit
+    code."""
+    ledger = {"families": {}, "regressions": []}
+    for family, rounds in sorted(families.items()):
+        metrics = SPEC.get(family, [])
+        base_idx = 0
+        if baseline_round is not None:
+            for i, (rnd, _, _) in enumerate(rounds):
+                if rnd == baseline_round:
+                    base_idx = i
+                    break
+        base_round, _, base_doc = rounds[base_idx]
+        rows = []
+        for rnd, fname, doc in rounds:
+            row = {"round": rnd, "file": fname, "ok": _ok_flag(doc),
+                   "metrics": {}}
+            for metric in metrics:
+                value = metric.extract(doc)
+                base_value = metric.extract(base_doc)
+                entry = {"value": value, "direction": metric.direction}
+                if (value is not None and base_value not in (None, 0)
+                        and rnd != base_round):
+                    entry["delta_vs_" + base_round] = (
+                        value / base_value - 1.0)
+                row["metrics"][metric.label] = entry
+            rows.append(row)
+        ledger["families"][family] = {
+            "baseline": base_round, "rounds": rows}
+
+        # -- gate: newest vs baseline ---------------------------------
+        newest_round, newest_file, newest_doc = rounds[-1]
+        if _ok_flag(newest_doc) is False:
+            ledger["regressions"].append(
+                f"{family}/{newest_round}: record carries a failed "
+                f"verdict (ok/invariants false) — {newest_file}")
+        for metric in metrics:
+            value = metric.extract(newest_doc)
+            if value is None:
+                continue
+            if metric.max_abs is not None and value > metric.max_abs:
+                ledger["regressions"].append(
+                    f"{family}/{newest_round}: {metric.label} = "
+                    f"{value:g} breaches the hard bound "
+                    f"<= {metric.max_abs:g}")
+                continue
+            if metric.direction == "info" or newest_round == base_round:
+                continue
+            base_value = metric.extract(base_doc)
+            if base_value in (None, 0) or math.isnan(base_value):
+                continue
+            ratio = value / base_value
+            if metric.direction == "higher" and ratio < 1 - metric.tolerance:
+                ledger["regressions"].append(
+                    f"{family}/{newest_round}: {metric.label} fell to "
+                    f"{ratio:.2f}x of {base_round} ({value:g} vs "
+                    f"{base_value:g}; tolerance -{metric.tolerance:.0%})")
+            elif metric.direction == "lower" and ratio > 1 + metric.tolerance:
+                ledger["regressions"].append(
+                    f"{family}/{newest_round}: {metric.label} rose to "
+                    f"{ratio:.2f}x of {base_round} ({value:g} vs "
+                    f"{base_value:g}; tolerance +{metric.tolerance:.0%})")
+    return ledger
+
+
+def render(ledger: dict) -> str:
+    out = []
+    for family, data in sorted(ledger["families"].items()):
+        out.append(f"{family}  (baseline {data['baseline']})")
+        for row in data["rounds"]:
+            ok = {True: "ok", False: "FAIL", None: "-"}[row["ok"]]
+            cells = []
+            for label, entry in row["metrics"].items():
+                if entry["value"] is None:
+                    continue
+                cell = f"{label}={entry['value']:g}"
+                for key, delta in entry.items():
+                    if key.startswith("delta_vs_"):
+                        cell += f" ({delta:+.1%} vs {key[9:]})"
+                cells.append(cell)
+            out.append(f"  {row['round']:>4s}  [{ok:>4s}]  "
+                       + ("  ".join(cells) if cells else "(no key metrics)"))
+    out.append("")
+    if ledger["regressions"]:
+        out.append("REGRESSIONS:")
+        out.extend(f"  {line}" for line in ledger["regressions"])
+    else:
+        out.append("no regressions past tolerance")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--root", default=_REPO,
+                   help="directory holding BENCH_*.json (default: repo "
+                        "root)")
+    p.add_argument("--baseline", default=None, metavar="ROUND",
+                   help="round tag to measure deltas against (default: "
+                        "each family's earliest round)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the ledger as JSON")
+    args = p.parse_args(argv)
+
+    families = collect(args.root)
+    if not families:
+        print(f"bench_ledger: no BENCH_*.json under {args.root}",
+              file=sys.stderr)
+        return 1
+    ledger = build_ledger(families, baseline_round=args.baseline)
+    print(render(ledger))
+    if args.json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
+                    exist_ok=True)
+        with open(args.json, "w") as fh:
+            json.dump(ledger, fh, indent=2)
+            fh.write("\n")
+    return 1 if ledger["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

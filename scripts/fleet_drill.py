@@ -97,10 +97,12 @@ class LoadGenerator:
     answer is never misread as a lost one."""
 
     def __init__(self, base: str, z_size: int, threads: int = 2,
-                 timeout: float = 30.0, pace: float = 0.005):
+                 timeout: float = 30.0, pace: float = 0.005,
+                 rows: tuple = (1, 4)):
         self.base = base
         self.z_size = z_size
         self.timeout = timeout
+        self.rows = rows  # rng.integers(*rows) rows per request
         self.stop = threading.Event()
         self.counts = {"sent": 0, "ok": 0, "shed": 0, "error": 0, "lost": 0}
         self.ok_latencies: list = []
@@ -111,7 +113,7 @@ class LoadGenerator:
     def _run(self, tid: int, pace: float) -> None:
         rng = np.random.default_rng(2000 + tid)
         while not self.stop.is_set():
-            rows = (rng.random((int(rng.integers(1, 4)), self.z_size),
+            rows = (rng.random((int(rng.integers(*self.rows)), self.z_size),
                                dtype=np.float32) * 2.0 - 1.0)
             with self._lock:
                 self.counts["sent"] += 1
@@ -663,6 +665,365 @@ def run_autoscale(args) -> int:
 
 
 # ===========================================================================
+# the alerting drill (--alerts)
+# ===========================================================================
+
+class AlertsMonitor:
+    """Polls the router's /alerts continuously: every alertname ever seen
+    FIRING (with a sample payload), plus audit windows — during a window
+    opened by :meth:`open_window`, any firing instance is recorded as a
+    false fire. The drill's ground truth for 'fires on the fault, silent
+    when calm'."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.stop = threading.Event()
+        self.fired: dict = {}          # alertname -> first firing entry
+        self.false_fires: list = []    # firing entries seen inside windows
+        self._window = None            # (name,) when an audit window is open
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        while not self.stop.is_set():
+            status, body = http_json("GET", f"{self.base}/alerts",
+                                     timeout=5.0)
+            if status == 200 and body:
+                firing = [e for e in body.get("alerts", [])
+                          if e.get("state") == "firing"]
+                with self._lock:
+                    window = self._window
+                    for entry in firing:
+                        self.fired.setdefault(entry["alert"], entry)
+                        if window is not None:
+                            self.false_fires.append(
+                                {"window": window, **entry})
+            time.sleep(0.1)
+
+    def open_window(self, name: str) -> None:
+        with self._lock:
+            self._window = name
+
+    def close_window(self) -> None:
+        with self._lock:
+            self._window = None
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def finish(self) -> None:
+        self.stop.set()
+        self._thread.join(timeout=10.0)
+
+
+def _firing_names(alerts_body: dict) -> set:
+    return {e["alert"] for e in (alerts_body or {}).get("alerts", [])
+            if e.get("state") == "firing"}
+
+
+def run_alerts(args) -> int:
+    """The fire-and-resolve drill (docs/OBSERVABILITY.md "Alerting"):
+    boot a fleet with the alert plane on, prove the default rule pack
+    end-to-end — calm phases stay silent, a SIGKILLed worker fires
+    ``worker_down`` with the dead pid and an exemplar trace id that
+    resolves into the merged ``GET /debug/trace``, an overload ramp
+    fires ``latency_anomaly``, quiesce resolves both, and the
+    exactly-one-answer ledger holds throughout."""
+    n_workers = args.workers or 2
+    burst_threads = args.burst_threads or 12
+    workdir = args.workdir or tempfile.mkdtemp(prefix="fleet_alerts_")
+    cleanup = args.workdir is None
+    os.makedirs(workdir, exist_ok=True)
+    serve_store = os.path.join(workdir, "store_serve")
+    workload = make_workload(workdir, args.seed)
+    z_size = 4  # the drill workload's latent width (make_workload)
+    results: dict = {}
+    invariants: dict = {}
+    fleet = None
+    load = burst = monitor = None
+    router_port = free_port()
+    base = f"http://127.0.0.1:{router_port}"
+    calm_audit_s = 6.0 if args.smoke else 10.0
+
+    try:
+        # -- phase 0: seed + boot with the alert plane on ----------------
+        gen0 = seed_bundle(workload, serve_store, args.keep_last)
+        log(f"seeded serving generation {gen0}")
+        fleet_log = open(os.path.join(workdir, "fleet.log"), "w")
+        fleet = subprocess.Popen(
+            FLEET + [
+                "--store", serve_store,
+                "--workers", str(n_workers),
+                "--port", str(router_port),
+                "--log-dir", workdir,
+                "--poll", "2.0", "--probe-interval", "0.15",
+                "--request-timeout", "10.0",
+                "--retry-ratio", "0.5", "--retry-burst", "10",
+                "--eject-failures", "3", "--reopen-after", "0.5",
+                "--drain-timeout", "15", "--warm-timeout", "240",
+                "--hang-restart", "30",
+                "--buckets", "1,8", "--replicas", "1",
+                "--max-latency", "0.002",
+                "--boot-wait", "60",
+                "--slo-fast-window", "5", "--slo-slow-window", "30",
+                "--telemetry",
+                "--alerts", "--alert-stale-after", "10",
+                # drill scale: the toy workload's p99 drifts by hundreds
+                # of ms, not the production default's ~0.6 s page bar
+                "--alert-latency-drift", "0.02",
+            ],
+            cwd=_REPO, env=_ENV, stdout=fleet_log, stderr=fleet_log,
+        )
+        health = wait_for(
+            lambda: (fleet.poll() is None
+                     and (h := fleet_health(base)).get("routable")
+                     == n_workers and h.get("generation") == gen0 and h),
+            420.0, "fleet healthy with the alert plane on")
+        if not health:
+            log(f"fleet never became healthy (rc={fleet.poll()})")
+            return 2
+        _, alerts0 = http_json("GET", f"{base}/alerts", timeout=10.0)
+        invariants["alert_surface_up"] = bool(
+            alerts0 and alerts0.get("rules"))
+        results["rules"] = [r.get("name")
+                            for r in (alerts0 or {}).get("rules", [])]
+        log(f"alert plane up: rules {results['rules']}")
+        monitor = AlertsMonitor(base)
+        monitor.start()
+
+        # -- phase 1: calm — baselines build, nothing may fire -----------
+        load = LoadGenerator(base, z_size, threads=2, timeout=30.0,
+                             pace=0.02)
+        load.start()
+        time.sleep(4.0)  # settle: baselines arm, boot noise ages out
+        monitor.open_window("calm_1")
+        time.sleep(calm_audit_s)
+        monitor.close_window()
+        invariants["calm1_zero_firing"] = not any(
+            f["window"] == "calm_1" for f in monitor.false_fires)
+        log(f"calm-1 audit done ({calm_audit_s:.0f}s, firing seen: "
+            f"{sorted(_firing_names(http_json('GET', base + '/alerts', timeout=5.0)[1]))})")
+
+        # -- phase 2: SIGKILL -> worker_down fires with evidence ---------
+        victim = worker_by_id(fleet_health(base), "w0")
+        log(f"SIGKILL worker w0 (pid {victim.get('pid')})")
+        os.kill(victim["pid"], signal.SIGKILL)
+        fired = wait_for(
+            lambda: next(
+                (e for e in (http_json("GET", f"{base}/alerts",
+                                       timeout=5.0)[1] or {})
+                 .get("alerts", [])
+                 if e.get("alert") == "worker_down"
+                 and e.get("state") == "firing"
+                 and e.get("labels", {}).get("worker") == "w0"), None),
+            90.0, "worker_down firing for w0")
+        invariants["worker_down_fires"] = bool(fired)
+        exemplars = (fired or {}).get("exemplars") or []
+        exemplar_pids = {e.get("pid") for e in exemplars}
+        exemplar_ids = [e.get("trace_id") for e in exemplars
+                        if e.get("trace_id")]
+        invariants["worker_down_labels_dead_pid"] = (
+            victim.get("pid") in exemplar_pids)
+        invariants["worker_down_has_exemplar"] = bool(exemplar_ids)
+        results["worker_down"] = {
+            "victim_pid": victim.get("pid"),
+            "alert": fired,
+        }
+        # the exemplar ids must resolve into the merged fleet trace —
+        # an alert is one click from the causal chain of a bad request
+        _, merged = http_json("GET", f"{base}/debug/trace", timeout=20.0)
+        trace_ids = {(e.get("args") or {}).get("trace_id")
+                     for e in (merged or {}).get("traceEvents", [])}
+        linked = sorted(set(exemplar_ids) & trace_ids)
+        invariants["exemplar_trace_in_merged_trace"] = bool(linked)
+        results["worker_down"]["exemplars_in_trace"] = linked
+        # surfaces: prom ALERTS series, healthz block, transition counter
+        import urllib.request
+        with urllib.request.urlopen(f"{base}/alerts?format=prom",
+                                    timeout=10.0) as resp:
+            prom = resp.read().decode()
+        invariants["prom_alerts_series"] = (
+            'ALERTS{alertname="worker_down"' in prom
+            and 'state="firing"' in prom)
+        hz = fleet_health(base)
+        invariants["healthz_alerts_block"] = any(
+            f.get("alert") == "worker_down"
+            for f in (hz.get("alerts") or {}).get("firing", []))
+        _, fleet_snap = http_json("GET", f"{base}/metrics?scope=fleet",
+                                  timeout=30.0)
+        invariants["transition_counter_surfaced"] = _counter_total(
+            fleet_snap, "fleet_alerts_total",
+            match={"alertname": "worker_down", "state": "firing"}) >= 1
+
+        # -- phase 3: relaunch + re-admission -> worker_down resolves ----
+        recovered = wait_for(
+            lambda: (h := fleet_health(base)).get("routable") == n_workers
+            and h,
+            300.0, "killed worker relaunched and re-admitted")
+        invariants["worker_relaunched"] = bool(recovered)
+        resolved = wait_for(
+            lambda: "worker_down" not in _firing_names(
+                http_json("GET", f"{base}/alerts", timeout=5.0)[1]),
+            60.0, "worker_down resolves after re-admission")
+        invariants["worker_down_resolves"] = bool(resolved)
+        log("worker_down resolved")
+
+        # -- phase 4: overload ramp -> latency_anomaly fires -------------
+        # slab-shaped burst: 200-256-row samples chunk through the
+        # 8-bucket ladder (25-32 flushes each), so the light load's small
+        # requests queue behind real work and their router-measured
+        # latency genuinely drifts — the signal the anomaly rule exists
+        # for (the p99 must clear the rule's MAD floor, ~0.6 s over the
+        # calm baseline)
+        burst = LoadGenerator(base, z_size, threads=0, timeout=60.0,
+                              rows=(200, 257))
+        burst.add_threads(burst_threads + 4, pace=0.002)
+        log(f"overload ramp: +{burst_threads} slab-slinging threads")
+        anomaly = wait_for(
+            lambda: "latency_anomaly" in _firing_names(
+                http_json("GET", f"{base}/alerts", timeout=5.0)[1]),
+            150.0, "latency_anomaly firing under overload")
+        invariants["latency_anomaly_fires"] = bool(anomaly)
+        _, mid_alerts = http_json("GET", f"{base}/alerts", timeout=5.0)
+        results["overload_firing"] = sorted(_firing_names(mid_alerts))
+        _, mid_snap = http_json("GET", f"{base}/metrics?scope=fleet",
+                                timeout=30.0)
+        lat = ((mid_snap or {}).get("fleet_request_latency_seconds")
+               or {}).get("series") or [{}]
+        results["overload_latency"] = {
+            k: lat[0].get(k) for k in ("p50", "p99", "count")}
+
+        # -- phase 5: quiesce -> everything resolves ---------------------
+        burst_counts = burst.finish()
+        burst = None
+        log("burst stopped — waiting for every alert to resolve "
+            "(the light load keeps the latency stream fresh)")
+        quiet = wait_for(
+            lambda: not _firing_names(
+                http_json("GET", f"{base}/alerts", timeout=5.0)[1]),
+            180.0, "all alerts resolve after quiesce")
+        invariants["all_alerts_resolve"] = bool(quiet)
+
+        # -- phase 6: calm again — still nothing may fire ----------------
+        monitor.open_window("calm_2")
+        time.sleep(calm_audit_s)
+        monitor.close_window()
+        invariants["calm2_zero_firing"] = not any(
+            f["window"] == "calm_2" for f in monitor.false_fires)
+        monitor.finish()
+
+        # -- phase 7: audits + ledger ------------------------------------
+        # every alertname that ever fired must be explainable by the
+        # faults this drill injected; anything else is a false fire
+        expected = {"worker_down", "latency_anomaly"}
+        allowed = expected | {"slo_availability_burn", "slo_latency_burn",
+                              "queue_pressure_anomaly", "scrape_stale"}
+        ever_fired = set(monitor.fired)
+        invariants["expected_alerts_fired"] = expected <= ever_fired
+        invariants["no_unexpected_alertnames"] = ever_fired <= allowed
+        results["ever_fired"] = sorted(ever_fired)
+        results["false_fires"] = len(monitor.false_fires)
+        results["false_fire_entries"] = monitor.false_fires[:20]
+
+        counts = load.finish()
+        load = None
+        for key, value in burst_counts.items():
+            counts[key] = counts.get(key, 0) + value
+        results["requests"] = counts
+        _, router_metrics = http_json("GET", f"{base}/metrics",
+                                      timeout=5.0)
+        router_metrics = router_metrics or {}
+        results["router"] = {
+            k: router_metrics.get(k)
+            for k in ("proxied", "ok", "error", "retries",
+                      "budget_exhausted", "no_worker",
+                      "attempts_exhausted", "ejections")
+        }
+        invariants["exactly_one_answer_zero_lost"] = (
+            counts["lost"] == 0 and counts["error"] == 0
+            and counts["ok"] + counts["shed"] + counts["error"]
+            == counts["sent"])
+        honest_503s = ((router_metrics.get("budget_exhausted") or 0)
+                       + (router_metrics.get("no_worker") or 0)
+                       + (router_metrics.get("attempts_exhausted") or 0))
+        invariants["sheds_bounded_by_honest_503s"] = (
+            counts["shed"] <= honest_503s)
+
+        # -- phase 8: the incident as ONE timeline -----------------------
+        # (scripts/trace_report.py --alerts: spans + alert transitions)
+        _, final_alerts = http_json("GET", f"{base}/alerts", timeout=10.0)
+        alerts_out = os.path.join(workdir, "alerts.json")
+        with open(alerts_out, "w") as fh:
+            json.dump(final_alerts or {}, fh)
+            fh.write("\n")
+        trace_out = args.trace_out or os.path.join(workdir,
+                                                   "alerts_trace.json")
+        _, merged = http_json("GET", f"{base}/debug/trace", timeout=30.0)
+        with open(trace_out, "w") as fh:
+            json.dump(merged or {}, fh)
+            fh.write("\n")
+        report = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "trace_report.py"),
+             trace_out, "--alerts", alerts_out],
+            capture_output=True, text=True, timeout=120.0)
+        invariants["trace_report_alert_overlay"] = report.returncode == 0
+        results["incident_timeline"] = {
+            "alerts_json": alerts_out, "trace": trace_out,
+            "trace_report_rc": report.returncode,
+            "incidents": len((final_alerts or {}).get("incidents", [])),
+        }
+        log(f"trace_report --alerts rc={report.returncode}")
+    finally:
+        for gen in (load, burst):
+            if gen is not None:
+                gen.finish()
+        if monitor is not None and not monitor.stop.is_set():
+            monitor.finish()
+        if fleet is not None and fleet.poll() is None:
+            fleet.terminate()
+            try:
+                fleet.wait(timeout=20.0)
+            except subprocess.TimeoutExpired:
+                fleet.kill()
+
+    ok = bool(invariants) and all(invariants.values())
+    payload = {
+        "bench": "fleet_alerts_drill",
+        "config": {
+            "workers": n_workers,
+            "burst_threads": burst_threads,
+            "calm_audit_s": calm_audit_s,
+            "seed": args.seed,
+            "smoke": bool(args.smoke),
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "results": results,
+        "invariants": invariants,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.output:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(os.path.join(_REPO,
+                               f"BENCH_alerts_{args.record}.json"),
+                  "w") as fh:
+            fh.write(text + "\n")
+    if cleanup and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        log(f"INVARIANT BREACH — work files kept at {workdir}")
+    for name, good in sorted(invariants.items()):
+        log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+    return 0 if ok else 1
+
+
+# ===========================================================================
 # the multi-model multiplexing drill (--mux)
 # ===========================================================================
 
@@ -993,6 +1354,13 @@ def main(argv=None) -> int:
                         "injected-burn auto-rollback, per-model brownout "
                         "shed order (docs/MULTIPLEX.md; --record writes "
                         "BENCH_mux_<TAG>.json)")
+    p.add_argument("--alerts", action="store_true",
+                   help="run the alerting fire-and-resolve drill instead: "
+                        "SIGKILL -> worker_down with the dead pid + an "
+                        "exemplar trace, overload -> latency anomaly, "
+                        "quiesce -> both resolve, zero false fires in the "
+                        "calm audits (docs/OBSERVABILITY.md 'Alerting'; "
+                        "--record writes BENCH_alerts_<TAG>.json)")
     p.add_argument("--max-workers", type=int, default=None,
                    help="autoscale ceiling (default 3; --workers is the "
                         "min, default 1)")
@@ -1005,12 +1373,14 @@ def main(argv=None) -> int:
                         "admitted (200) requests")
     args = p.parse_args(argv)
 
-    if args.autoscale and args.mux:
-        p.error("--autoscale and --mux are separate drills")
+    if sum(map(bool, (args.autoscale, args.mux, args.alerts))) > 1:
+        p.error("--autoscale, --mux, and --alerts are separate drills")
     if args.autoscale:
         return run_autoscale(args)
     if args.mux:
         return run_mux(args)
+    if args.alerts:
+        return run_alerts(args)
 
     n_workers = args.workers or (2 if args.smoke else 3)
     total = args.total_steps or (12 if args.smoke else 24)

@@ -144,6 +144,20 @@ if ! JAX_PLATFORMS=cpu timeout 1200 python scripts/fleet_drill.py --smoke \
   echo "$(date +%H:%M:%S) fleet mux smoke failed — campaign aborted (see fleet_mux_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Alerts smoke (CPU, fleet with the alerting plane on): the campaign's
+# fleet pages a human when something breaks — refuse to start if the
+# fire-and-resolve story regressed: worker_down firing on a real SIGKILL
+# with the dead pid labeled and an exemplar trace id resolvable in the
+# merged /debug/trace, latency anomaly firing under an overload ramp,
+# both resolving after quiesce, zero false fires in the calm audit
+# windows, zero-lost ledger (enforced by the drill's own exit code).
+# Pinned to CPU so it never touches the chip.
+if ! JAX_PLATFORMS=cpu timeout 1500 python scripts/fleet_drill.py --smoke \
+    --alerts \
+    --output artifacts/fleet_alerts_smoke.json > fleet_alerts_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) fleet alerts smoke failed — campaign aborted (see fleet_alerts_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0
@@ -269,7 +283,20 @@ EOF
       fi
       echo "$(date +%H:%M:%S) quality rc=$rc done=$quality_done" >> tpu_poller.log
     fi
-    if [ "$bench_done" -eq 1 ] && [ "$ceiling_done" -eq 1 ] && [ "$tune_done" -eq 1 ] && [ "$quality_done" -eq 1 ]; then exit 0; fi
+    if [ "$bench_done" -eq 1 ] && [ "$ceiling_done" -eq 1 ] && [ "$tune_done" -eq 1 ] && [ "$quality_done" -eq 1 ]; then
+      # Post-step: the bench ledger folds every BENCH_*.json into one
+      # trend table and exits nonzero when the newest round of any
+      # family regressed past its tolerance (or breached a hard bound
+      # like lost>0) — the "TPU-measured truth" machine gate: a campaign
+      # that quietly made a recorded number worse must fail here, not
+      # ship the worse number as the new baseline.
+      if ! timeout 120 python scripts/bench_ledger.py \
+          --json artifacts/bench_ledger.json > bench_ledger.log 2>&1; then
+        echo "$(date +%H:%M:%S) bench ledger gate failed — regression recorded (see bench_ledger.log)" >> tpu_poller.log
+        exit 1
+      fi
+      exit 0
+    fi
   fi
   sleep 60
 done

@@ -24,6 +24,12 @@ and answers the questions a wall of spans hides:
    into a table that NAMES the straggler of each coordinated publish: the
    worker with the longest stage time is the one everyone else's
    commit-wait paid for.
+5. **alert overlay** (``--alerts <alerts.json>``) — the fleet alert
+   plane's incident ring (the ``GET /alerts`` payload, or a bare list of
+   transition records) rendered as instant events on the same wall-epoch
+   timeline, so an incident reads as ONE story: the spans that slowed
+   down, the alert going pending → firing over them, and the resolve
+   after the recovery (docs/OBSERVABILITY.md "Alerting").
 
 Exit status is the campaign-gate contract: nonzero when a file is
 missing, malformed, or the merged trace contains no complete spans — an
@@ -51,6 +57,39 @@ from collections import defaultdict
 #: span names whose (gen, worker) args drive the barrier table
 STAGE_SPAN = "resilience.mesh_stage"
 WAIT_SPAN = "resilience.mesh_commit_wait"
+
+
+def alert_events(path: str) -> list:
+    """Alert lifecycle transitions as Chrome instant events. Accepts the
+    ``GET /alerts`` payload (reads its ``incidents`` ring) or a bare list
+    of transition records; each record's wall-clock ``t`` lands on the
+    same epoch the span tracers pin their timestamps to, so the overlay
+    and the spans share one timeline. Raises ValueError on anything that
+    is not alert-shaped — a wrong file must fail the report, not overlay
+    nothing."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    records = doc.get("incidents") if isinstance(doc, dict) else doc
+    if not isinstance(records, list):
+        raise ValueError(f"{path}: not an /alerts payload "
+                         f"(no incidents list)")
+    events = []
+    for record in records:
+        if not (isinstance(record, dict) and "alert" in record
+                and isinstance(record.get("t"), (int, float))):
+            raise ValueError(f"{path}: malformed incident record "
+                             f"{record!r}")
+        to = record.get("to", "?")
+        events.append({
+            "name": f"alert:{record['alert']}:{to}",
+            "ph": "i",
+            "s": "g",  # global scope: the marker spans the whole track
+            "ts": record["t"] * 1e6,
+            "pid": "alerts",
+            "tid": record.get("severity", "alert"),
+            "args": {k: v for k, v in record.items() if k != "t"},
+        })
+    return events
 
 
 def load_events(path: str) -> list:
@@ -307,6 +346,11 @@ def main(argv=None) -> int:
                    help="also write the report as JSON")
     p.add_argument("--merge-out", default=None, metavar="PATH",
                    help="write the merged Chrome trace (Perfetto-loadable)")
+    p.add_argument("--alerts", default=None, metavar="PATH",
+                   help="overlay the alert plane's firing/resolved "
+                        "transitions (a GET /alerts payload, or a bare "
+                        "incident list) as instant events on the merged "
+                        "timeline")
     args = p.parse_args(argv)
 
     events: list = []
@@ -322,11 +366,30 @@ def main(argv=None) -> int:
                     f"violation(s)\n")
                 return 1
             events.extend(file_events)
+        overlay = alert_events(args.alerts) if args.alerts else []
+        events.extend(overlay)
         report = fold(events, top_n=args.top)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         sys.stderr.write(f"trace_report: {exc}\n")
         return 1
+    if overlay:
+        by_state: dict = {}
+        for ev in overlay:
+            to = (ev.get("args") or {}).get("to", "?")
+            by_state[to] = by_state.get(to, 0) + 1
+        report["alerts"] = {"transitions": len(overlay),
+                            "by_state": dict(sorted(by_state.items()))}
     print(render(report))
+    if overlay:
+        print("\nalert overlay:")
+        for ev in overlay:
+            arg = ev.get("args") or {}
+            labels = arg.get("labels") or {}
+            label_text = ",".join(f"{k}={v}" for k, v in
+                                  sorted(labels.items()))
+            print(f"  {ev['ts'] / 1e6:.3f}s  {arg.get('alert', '?'):<28s}"
+                  f"  {arg.get('from', '?')} -> {arg.get('to', '?')}"
+                  f"  {{{label_text}}}")
     if args.merge_out:
         os.makedirs(os.path.dirname(os.path.abspath(args.merge_out)),
                     exist_ok=True)

@@ -1,0 +1,565 @@
+"""telemetry/alerts.py — the fleet alerting & anomaly-detection plane.
+
+Covers the declarative rule kinds (threshold / absence / burn /
+anomaly), the pending→firing→resolved lifecycle with per-direction
+hysteresis, the fail-closed three-valued evaluation (NaN / empty
+baselines / missing series may reach pending, never firing — and never
+resolve a firing alert), exemplar capture, sinks, the prom ``ALERTS``
+rendering, the default rule packs, and the registry's series-removal
+seam the member gauges rely on. Everything here is stdlib-only and
+clock-injected — no sleeps, no sockets except the webhook test's local
+receiver.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from gan_deeplearning4j_tpu.telemetry.alerts import (
+    AlertManager,
+    AlertRule,
+    ExemplarStore,
+    WebhookSink,
+    default_fleet_rules,
+    default_mux_rules,
+)
+from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+
+def gauge_snap(name, value, labels=None):
+    return {name: {"type": "gauge", "help": "",
+                   "series": [{"labels": labels or {}, "value": value}]}}
+
+
+def manager(rules, **kw):
+    clockbox = kw.pop("clockbox", [0.0])
+
+    def clock():
+        clockbox[0] += 1.0
+        return clockbox[0]
+
+    return AlertManager(rules, clock=clock, wall_clock=clock, **kw), clockbox
+
+
+def states_for(mgr, name="r"):
+    active = [e for e in mgr.active() if e["alert"] == name]
+    return active[0]["state"] if active else "inactive"
+
+
+THRESHOLD = dict(name="r", kind="threshold", metric="g", op=">", bound=5.0,
+                 for_ticks=2, keep_firing_ticks=2, resolved_hold_ticks=2)
+
+
+# ===========================================================================
+# lifecycle
+# ===========================================================================
+
+class TestLifecycle:
+    def test_full_cycle_with_hysteresis(self):
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        seen = []
+        for v in [1, 9, 9, 9, 1, 1, 1, 1]:
+            mgr.evaluate(gauge_snap("g", v))
+            seen.append(states_for(mgr))
+        # 1 breach = pending (not firing: for_ticks=2); 2 clears to leave
+        # firing; resolved visible for resolved_hold_ticks then inactive
+        assert seen == ["inactive", "pending", "firing", "firing",
+                        "firing", "resolved", "resolved", "inactive"]
+
+    def test_flap_cannot_reach_firing(self):
+        # breach/clear alternation never accumulates for_ticks=2
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        for v in [9, 1, 9, 1, 9, 1, 9, 1]:
+            mgr.evaluate(gauge_snap("g", v))
+            assert states_for(mgr) in ("pending", "inactive")
+
+    def test_breach_while_firing_rearms_the_resolve_hysteresis(self):
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        for v in [9, 9]:
+            mgr.evaluate(gauge_snap("g", v))
+        assert states_for(mgr) == "firing"
+        # one clear, then a breach: the clear streak resets, still firing
+        for v in [1, 9, 1]:
+            mgr.evaluate(gauge_snap("g", v))
+        assert states_for(mgr) == "firing"
+
+    def test_transitions_counted_per_alertname_and_state(self):
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        for v in [9, 9, 1, 1]:
+            mgr.evaluate(gauge_snap("g", v))
+        fam = {tuple(sorted(labels.items())): series.value
+               for labels, series in get_registry()
+               ._families["fleet_alerts_total"].series()}
+        assert fam[(("alertname", "r"), ("state", "pending"))] == 1
+        assert fam[(("alertname", "r"), ("state", "firing"))] == 1
+        assert fam[(("alertname", "r"), ("state", "resolved"))] == 1
+
+    def test_incident_ring_bounded_and_ordered(self):
+        mgr, _ = manager([AlertRule(**THRESHOLD)], max_incidents=4)
+        for v in [9, 9, 1, 1, 9, 9, 1, 1]:
+            mgr.evaluate(gauge_snap("g", v))
+        incidents = mgr.snapshot()["incidents"]
+        assert len(incidents) == 4  # bounded, newest kept
+        assert [i["to"] for i in incidents][-1] in ("resolved", "inactive")
+
+    def test_per_series_instances_with_labels(self):
+        # one rule over a labeled family fans out per series
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        snap = {"g": {"type": "gauge", "help": "", "series": [
+            {"labels": {"worker": "w0"}, "value": 9.0},
+            {"labels": {"worker": "w1"}, "value": 1.0},
+        ]}}
+        mgr.evaluate(snap)
+        mgr.evaluate(snap)
+        active = mgr.active()
+        assert [(e["labels"], e["state"]) for e in active] == [
+            ({"worker": "w0"}, "firing")]
+
+    def test_arm_on_first_clear_suppresses_boot_breaches(self):
+        rule = AlertRule(**{**THRESHOLD, "op": "<", "bound": 1.0,
+                            "arm_on_first_clear": True})
+        mgr, _ = manager([rule])
+        # "down" from the first evaluation — boot, not a regression
+        for _ in range(5):
+            mgr.evaluate(gauge_snap("g", 0.0))
+        assert states_for(mgr) == "inactive"
+        mgr.evaluate(gauge_snap("g", 1.0))  # first healthy eval arms
+        for _ in range(2):
+            mgr.evaluate(gauge_snap("g", 0.0))
+        assert states_for(mgr) == "firing"
+
+
+# ===========================================================================
+# fail-closed evaluation
+# ===========================================================================
+
+class TestFailClosed:
+    def test_nan_reaches_pending_never_firing(self):
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        for _ in range(10):
+            mgr.evaluate(gauge_snap("g", float("nan")))
+            assert states_for(mgr) == "pending"
+
+    def test_none_value_reads_as_nan(self):
+        # a JSON-sanitized snapshot (null for NaN) evaluates identically
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        mgr.evaluate(gauge_snap("g", None))
+        assert states_for(mgr) == "pending"
+
+    def test_no_data_never_resolves_a_firing_alert(self):
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        for v in [9, 9]:
+            mgr.evaluate(gauge_snap("g", v))
+        assert states_for(mgr) == "firing"
+        for _ in range(10):
+            mgr.evaluate(gauge_snap("g", float("nan")))
+            assert states_for(mgr) == "firing"
+
+    def test_data_gap_resets_the_clear_streak(self):
+        # review-caught: keep_firing_ticks means CONSECUTIVE clears —
+        # two clears separated by a blind spot (the scrape wedging
+        # during the very incident being alerted on) must not sum up
+        # and resolve a live breach
+        mgr, _ = manager([AlertRule(**THRESHOLD)])  # keep_firing_ticks=2
+        for v in [9, 9]:
+            mgr.evaluate(gauge_snap("g", v))
+        assert states_for(mgr) == "firing"
+        for v in [1, float("nan"), 1]:  # clear, gap, clear — not 2 in a row
+            mgr.evaluate(gauge_snap("g", v))
+        assert states_for(mgr) == "firing"
+        mgr.evaluate(gauge_snap("g", 1))  # the second CONSECUTIVE clear
+        assert states_for(mgr) == "resolved"
+
+    def test_vanished_series_resolves_after_hysteresis(self):
+        # the series being GONE (a retired worker) is not an ongoing
+        # breach: firing resolves after keep_firing_ticks unobserved
+        mgr, _ = manager([AlertRule(**THRESHOLD)])
+        for v in [9, 9]:
+            mgr.evaluate(gauge_snap("g", v))
+        assert states_for(mgr) == "firing"
+        empty = {"g": {"type": "gauge", "help": "", "series": []}}
+        mgr.evaluate(empty)
+        assert states_for(mgr) == "firing"
+        mgr.evaluate(empty)
+        assert states_for(mgr) == "resolved"
+
+    def test_anomaly_empty_baseline_pending_never_firing(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="anomaly", metric="h", field="p99",
+            window=50, min_points=10, for_ticks=1)])
+        for _ in range(5):
+            mgr.evaluate({"h": {"type": "histogram", "help": "", "series": [
+                {"labels": {}, "count": 1, "sum": 1.0, "p99": 0.01}]}})
+            assert states_for(mgr) == "pending"
+
+    def test_burn_nan_window_pending_never_firing(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="burn", metric="b", objective="availability",
+            for_ticks=1)])
+        snap = {"b": {"type": "gauge", "help": "", "series": [
+            {"labels": {"objective": "availability", "window": "fast"},
+             "value": 5.0},
+            {"labels": {"objective": "availability", "window": "slow"},
+             "value": float("nan")},
+        ]}}
+        for _ in range(3):
+            mgr.evaluate(snap)
+            assert states_for(mgr) == "pending"
+
+
+# ===========================================================================
+# rule kinds
+# ===========================================================================
+
+class TestRuleKinds:
+    def test_absence_fires_on_missing_series(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="absence", metric="g",
+            labels={"worker": "w0"}, for_ticks=2, keep_firing_ticks=1)])
+        mgr.evaluate({})
+        mgr.evaluate({})
+        assert states_for(mgr) == "firing"
+        mgr.evaluate(gauge_snap("g", 1.0, labels={"worker": "w0"}))
+        assert states_for(mgr) == "resolved"
+
+    def test_threshold_rate_on_counter(self):
+        mgr, clockbox = manager([AlertRule(
+            name="r", kind="threshold", metric="c", rate=True,
+            op=">", bound=0.0, for_ticks=1, keep_firing_ticks=1)])
+        counter = lambda v: {"c": {"type": "counter", "help": "",  # noqa: E731
+                                   "series": [{"labels": {}, "value": v}]}}
+        mgr.evaluate(counter(0))      # first point: rate undefined
+        assert states_for(mgr) == "pending"
+        mgr.evaluate(counter(0))      # rate 0 — clear
+        assert states_for(mgr) == "inactive"
+        mgr.evaluate(counter(3))      # climbing
+        assert states_for(mgr) == "firing"
+
+    def test_threshold_rate_counter_reset_is_undefined(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="threshold", metric="c", rate=True,
+            op=">", bound=0.0, for_ticks=1, keep_firing_ticks=1)])
+        counter = lambda v: {"c": {"type": "counter", "help": "",  # noqa: E731
+                                   "series": [{"labels": {}, "value": v}]}}
+        mgr.evaluate(counter(10))
+        mgr.evaluate(counter(11))
+        assert states_for(mgr) == "firing"
+        # a restarted process resets the counter: dv < 0 is undefined,
+        # not negative traffic — and no data never resolves
+        mgr.evaluate(counter(0))
+        assert states_for(mgr) == "firing"
+
+    def test_burn_requires_both_windows(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="burn", metric="b", objective="availability",
+            burn_threshold=1.0, for_ticks=1, keep_firing_ticks=1)])
+        snap = lambda fast, slow: {"b": {  # noqa: E731
+            "type": "gauge", "help": "", "series": [
+                {"labels": {"objective": "availability",
+                            "window": "fast"}, "value": fast},
+                {"labels": {"objective": "availability",
+                            "window": "slow"}, "value": slow}]}}
+        mgr.evaluate(snap(5.0, 0.1))   # fast only: the blip case
+        assert states_for(mgr) == "inactive"
+        mgr.evaluate(snap(5.0, 2.0))   # both: the page case
+        assert states_for(mgr) == "firing"
+
+    def test_burn_groups_per_model(self):
+        # the mux scoping: one rule, one instance per model label set
+        mgr, _ = manager([AlertRule(
+            name="r", kind="burn", metric="mux_slo_burn_rate",
+            objective="availability", for_ticks=1)])
+        series = []
+        for model, fast, slow in (("a", 9, 9), ("b", 0.1, 0.1)):
+            for window, value in (("fast", fast), ("slow", slow)):
+                series.append({"labels": {"model": model,
+                                          "objective": "availability",
+                                          "window": window},
+                               "value": value})
+        mgr.evaluate({"mux_slo_burn_rate": {"type": "gauge", "help": "",
+                                            "series": series}})
+        active = mgr.active()
+        assert [(e["labels"]["model"], e["state"]) for e in active] == [
+            ("a", "firing")]
+
+    def test_burn_ignores_other_objective(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="burn", metric="b", objective="availability",
+            for_ticks=1)])
+        mgr.evaluate({"b": {"type": "gauge", "help": "", "series": [
+            {"labels": {"objective": "latency", "window": "fast"},
+             "value": 9.0},
+            {"labels": {"objective": "latency", "window": "slow"},
+             "value": 9.0}]}})
+        assert mgr.active() == []
+
+    def test_anomaly_fires_on_drift_and_resolves(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="anomaly", metric="h", field="p99",
+            window=50, min_points=5, z_max=6.0, for_ticks=2,
+            keep_firing_ticks=2, mad_floor_frac=0.05)])
+        hist = lambda p99: {"h": {"type": "histogram", "help": "",  # noqa: E731
+                                  "series": [{"labels": {}, "count": 9,
+                                              "sum": 1.0, "p99": p99}]}}
+        seen = []
+        for v in [0.01, 0.011, 0.01, 0.012, 0.01, 0.011,
+                  0.2, 0.2, 0.2, 0.01, 0.011]:
+            mgr.evaluate(hist(v))
+            seen.append(states_for(mgr))
+        assert seen[6:9] == ["pending", "firing", "firing"]
+        assert seen[-1] == "resolved"
+
+    def test_anomaly_baseline_not_contaminated_by_breaches(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="anomaly", metric="h", field="p99",
+            window=50, min_points=5, z_max=6.0, for_ticks=1,
+            keep_firing_ticks=1)])
+        hist = lambda p99: {"h": {"type": "histogram", "help": "",  # noqa: E731
+                                  "series": [{"labels": {}, "count": 9,
+                                              "sum": 1.0, "p99": p99}]}}
+        for v in [0.01, 0.011, 0.01, 0.012, 0.01]:
+            mgr.evaluate(hist(v))
+        for _ in range(30):  # a long incident
+            mgr.evaluate(hist(0.5))
+        state = list(mgr._states["r"].values())[0]
+        assert max(state.baseline) < 0.1  # anomalous points never joined
+        mgr.evaluate(hist(0.01))  # recovery reads against the CLEAN base
+        assert states_for(mgr) == "resolved"
+
+    def test_anomaly_mad_floor_abs_for_zero_median(self):
+        # queue-depth-shaped series: median 0 + a blip of 1 must not be
+        # an infinite z
+        mgr, _ = manager([AlertRule(
+            name="r", kind="anomaly", metric="g", field=None,
+            window=50, min_points=5, z_max=8.0, mad_floor_abs=1.0,
+            for_ticks=1, keep_firing_ticks=1)])
+        for _ in range(6):
+            mgr.evaluate(gauge_snap("g", 0.0))
+        mgr.evaluate(gauge_snap("g", 2.0))
+        assert states_for(mgr) in ("inactive", "pending")
+        mgr.evaluate(gauge_snap("g", 50.0))
+        assert states_for(mgr) == "firing"
+
+    def test_gauge_anomaly_reads_value_when_field_none(self):
+        mgr, _ = manager([AlertRule(
+            name="r", kind="anomaly", metric="g", field=None,
+            window=50, min_points=3, z_max=6.0, for_ticks=1,
+            keep_firing_ticks=1)])
+        for v in [1.0, 1.1, 1.0, 0.9]:
+            mgr.evaluate(gauge_snap("g", v))
+        mgr.evaluate(gauge_snap("g", 100.0))
+        assert states_for(mgr) == "firing"
+
+
+# ===========================================================================
+# exemplars, annotations, sinks, surfaces
+# ===========================================================================
+
+class TestEvidenceAndSurfaces:
+    def test_firing_captures_matching_exemplars(self):
+        store = ExemplarStore()
+        store.record("worker_failure", "tid-1", worker="w0", pid=11)
+        store.record("worker_failure", "tid-2", worker="w1", pid=22)
+        store.record("worker_failure", "tid-3", worker="w0", pid=11)
+        mgr, _ = manager([AlertRule(
+            **{**THRESHOLD, "op": "<", "bound": 1.0,
+               "exemplar_category": "worker_failure",
+               "for_ticks": 1})], exemplars=store)
+        mgr.evaluate(gauge_snap("g", 0.0, labels={"worker": "w0"}))
+        [entry] = mgr.active()
+        ids = [e["trace_id"] for e in entry["exemplars"]]
+        assert ids == ["tid-3", "tid-1"]  # newest first, w1's excluded
+
+    def test_exemplar_store_bounded(self):
+        store = ExemplarStore(per_category=3)
+        for i in range(10):
+            store.record("latency", f"t{i}")
+        assert [e["trace_id"] for e in store.recent("latency", k=99)] == [
+            "t9", "t8", "t7"]
+
+    def test_annotate_hook_runs_at_pending(self):
+        mgr, _ = manager([AlertRule(
+            **{**THRESHOLD, "for_ticks": 1,
+               "annotate": lambda labels: {"pid": 4242}})])
+        mgr.evaluate(gauge_snap("g", 9.0))
+        [entry] = mgr.active()
+        assert entry["annotations"] == {"pid": 4242}
+        assert mgr.snapshot()["incidents"][0]["annotations"] == {"pid": 4242}
+
+    def test_sink_receives_transitions_and_bugs_are_contained(self):
+        seen = []
+
+        def bad_sink(record):
+            raise RuntimeError("sink bug")
+
+        mgr, _ = manager([AlertRule(**{**THRESHOLD, "for_ticks": 1})],
+                         sinks=(bad_sink, seen.append))
+        mgr.evaluate(gauge_snap("g", 9.0))
+        assert [r["to"] for r in seen] == ["pending", "firing"]
+
+    def test_prometheus_alerts_rendering(self):
+        mgr, _ = manager([AlertRule(**{**THRESHOLD, "for_ticks": 1})])
+        mgr.evaluate(gauge_snap("g", 9.0, labels={"worker": "w0"}))
+        text = mgr.to_prometheus()
+        assert '# TYPE ALERTS gauge' in text
+        assert ('ALERTS{alertname="r",severity="page",state="firing",'
+                'worker="w0"} 1') in text
+        # resolved instances leave the prom surface
+        for _ in range(2):
+            mgr.evaluate(gauge_snap("g", 1.0, labels={"worker": "w0"}))
+        assert "ALERTS{" not in mgr.to_prometheus()
+
+    def test_snapshot_and_health_block_shapes(self):
+        mgr, _ = manager([AlertRule(**{**THRESHOLD, "for_ticks": 1})])
+        mgr.evaluate(gauge_snap("g", 9.0))
+        snap = mgr.snapshot()
+        assert snap["rules"][0]["name"] == "r"
+        assert snap["counts"]["firing"] == 1
+        assert json.dumps(snap)  # JSON-safe (no NaN leaks)
+        block = mgr.health_block()
+        assert block["ok"] is False
+        assert block["firing"][0]["alert"] == "r"
+
+    def test_webhook_sink_delivers_with_bounded_retry(self):
+        hits = []
+
+        class Hook(BaseHTTPRequestHandler):
+            fail_first = [True]
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n))
+                if self.fail_first[0]:
+                    self.fail_first[0] = False
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                hits.append(body)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Hook)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            sink = WebhookSink(
+                f"http://127.0.0.1:{srv.server_address[1]}/hook",
+                timeout=2.0, retries=2, backoff_s=0.01)
+            sink({"alert": "r", "to": "firing"})
+            deadline = 50
+            while not hits and deadline:
+                deadline -= 1
+                threading.Event().wait(0.1)
+            assert hits and hits[0]["alert"] == "r"
+            assert sink.sent == 1
+            sink.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_webhook_thread_survives_non_oserror(self):
+        # review-caught: a malformed URL raises ValueError from urlopen
+        # — it must count as a failed delivery, not kill the daemon
+        # thread (which would silently drop every FUTURE page)
+        from gan_deeplearning4j_tpu.telemetry.alerts import WebhookSink
+
+        sink = WebhookSink("localhost:9/hook",  # no scheme: ValueError
+                           timeout=0.5, retries=0, backoff_s=0.0)
+        try:
+            sink({"alert": "a", "to": "firing"})
+            deadline = 50
+            while sink.failed < 1 and deadline:
+                deadline -= 1
+                threading.Event().wait(0.05)
+            assert sink.failed == 1
+            assert sink._thread.is_alive()  # the channel is still up
+            sink({"alert": "b", "to": "firing"})
+            deadline = 50
+            while sink.failed < 2 and deadline:
+                deadline -= 1
+                threading.Event().wait(0.05)
+            assert sink.failed == 2  # later records still processed
+        finally:
+            sink.close()
+
+    def test_duplicate_rule_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            AlertManager([AlertRule(**THRESHOLD),
+                          AlertRule(**THRESHOLD)])
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            AlertRule(name="x", kind="nope", metric="m").validate()
+        with pytest.raises(ValueError, match="bound"):
+            AlertRule(name="x", kind="threshold", metric="m").validate()
+        with pytest.raises(ValueError, match="for_ticks"):
+            AlertRule(name="x", kind="absence", metric="m",
+                      for_ticks=0).validate()
+        with pytest.raises(ValueError, match="min_points"):
+            AlertRule(name="x", kind="anomaly", metric="m", window=2,
+                      min_points=8).validate()
+
+
+# ===========================================================================
+# default packs + evaluation over a real merged snapshot
+# ===========================================================================
+
+class TestDefaultPacks:
+    def test_packs_validate_and_are_distinct(self):
+        fleet = default_fleet_rules()
+        mux = default_mux_rules()
+        assert {r.name for r in fleet} >= {
+            "worker_down", "scrape_stale", "slo_availability_burn",
+            "brownout_latched", "spawn_failures_climbing",
+            "latency_anomaly", "queue_pressure_anomaly"}
+        assert {r.name for r in mux} == {"model_slo_burn",
+                                         "model_queue_anomaly"}
+        AlertManager(fleet)  # constructs (validates every rule)
+
+    def test_evaluator_consumes_a_real_merged_snapshot(self):
+        # shape compatibility with telemetry/aggregate.merge_snapshots:
+        # the evaluator reads the fleet-scope payload unchanged
+        from gan_deeplearning4j_tpu.telemetry.aggregate import (
+            merge_snapshots,
+        )
+
+        part = {
+            "fleet_member_routable": {
+                "type": "gauge", "help": "",
+                "series": [{"labels": {"worker": "w0"}, "value": 0.0}]},
+        }
+        merged = merge_snapshots({"router": part})
+        rule = AlertRule(name="down", kind="threshold",
+                         metric="fleet_member_routable", op="<",
+                         bound=1.0, for_ticks=1, keep_firing_ticks=1)
+        mgr, _ = manager([rule])
+        mgr.evaluate(merged)
+        [entry] = mgr.active()
+        # the member's own worker label survived the merge (setdefault)
+        assert entry["labels"]["worker"] == "w0"
+        assert entry["state"] == "firing"
+
+
+# ===========================================================================
+# registry series removal (the member-gauge seam)
+# ===========================================================================
+
+class TestSeriesRemoval:
+    def test_family_remove_drops_one_series(self):
+        fam = get_registry().gauge("removal_g", "x",
+                                   labelnames=("worker",))
+        fam.labels(worker="w0").set(1.0)
+        fam.labels(worker="w1").set(2.0)
+        assert fam.remove(worker="w0") is True
+        assert fam.remove(worker="w0") is False  # already gone
+        assert [labels for labels, _ in fam.series()] == [{"worker": "w1"}]
+
+    def test_remove_validates_labels(self):
+        fam = get_registry().gauge("removal_g2", "x",
+                                   labelnames=("worker",))
+        with pytest.raises(ValueError):
+            fam.remove(nope="x")

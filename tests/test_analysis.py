@@ -3053,3 +3053,112 @@ class TestTreeIsClean:
         )
         assert proc.returncode == 0, proc.stderr
         assert proc.stdout.strip() == "1"
+
+
+# ===========================================================================
+# JG023 — unknown metric in alert rule
+# ===========================================================================
+
+class TestUnknownMetricInAlertRule:
+    def test_true_positive_typo_metric(self):
+        # the silent failure mode: the family is "fleet_member_up", the
+        # rule says "fleet_member_upp" — it evaluates nothing forever
+        r = run(
+            "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+            "from gan_deeplearning4j_tpu.telemetry.registry import get_registry\n"
+            "def setup():\n"
+            "    get_registry().gauge('fleet_member_up', 'x')\n"
+            "    return AlertRule(name='down', kind='threshold',\n"
+            "                     metric='fleet_member_upp',\n"
+            "                     op='<', bound=1.0)\n"
+        )
+        assert codes(r) == ["JG023"]
+        assert "fleet_member_upp" in r.active[0].message
+
+    def test_true_positive_positional_metric(self):
+        r = run(
+            "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+            "from gan_deeplearning4j_tpu.telemetry.registry import get_registry\n"
+            "def setup():\n"
+            "    get_registry().counter('requests_total', 'x')\n"
+            "    return AlertRule('r', 'absence', 'request_total')\n"
+        )
+        assert codes(r) == ["JG023"]
+
+    def test_true_positive_cross_module_still_checked(self):
+        # the family lives in another module of the same analysis run —
+        # the known set is project-wide, so the typo still surfaces
+        from gan_deeplearning4j_tpu.analysis import analyze_sources
+
+        report = analyze_sources({
+            "pkg/metrics.py": (
+                "from gan_deeplearning4j_tpu.telemetry.registry import get_registry\n"
+                "def families():\n"
+                "    get_registry().gauge('fleet_pressure_real', 'x')\n"
+            ),
+            "pkg/rules.py": (
+                "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+                "def rules():\n"
+                "    return [AlertRule(name='p', kind='anomaly',\n"
+                "                      metric='fleet_pressure_reel')]\n"
+            ),
+        })
+        assert [f.code for f in report.active] == ["JG023"]
+        assert report.active[0].path == "pkg/rules.py"
+
+    def test_true_negative_exact_family(self):
+        r = run(
+            "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+            "from gan_deeplearning4j_tpu.telemetry.registry import get_registry\n"
+            "def setup():\n"
+            "    get_registry().gauge('fleet_member_up', 'x')\n"
+            "    return AlertRule(name='down', kind='threshold',\n"
+            "                     metric='fleet_member_up',\n"
+            "                     op='<', bound=1.0)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_fstring_family_pattern(self):
+        # the SLOTracker shape: the family name is prefix-scoped at
+        # construction; any rule matching the pattern resolves
+        r = run(
+            "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+            "from gan_deeplearning4j_tpu.telemetry.registry import get_registry\n"
+            "def setup(prefix):\n"
+            "    get_registry().gauge(f'{prefix}_slo_burn_rate', 'x')\n"
+            "    return AlertRule(name='b', kind='burn',\n"
+            "                     metric='mux_slo_burn_rate')\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_module_constant_family(self):
+        # aggregate.MEMBER_UP-style declaration: a module-level ALL_CAPS
+        # string constant that looks like a metric name counts
+        r = run(
+            "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+            "MEMBER_UP = 'fleet_member_up'\n"
+            "def setup():\n"
+            "    return AlertRule(name='down', kind='threshold',\n"
+            "                     metric='fleet_member_up',\n"
+            "                     op='<', bound=1.0)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_non_literal_metric(self):
+        # computed names are out of scope: silence, not a guess
+        r = run(
+            "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+            "def setup(family):\n"
+            "    return AlertRule(name='x', kind='absence', metric=family)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_unrelated_call_named_alertrule_elsewhere(self):
+        # no AlertRule constructions at all: the known-family scan never
+        # even runs
+        r = run(
+            "from gan_deeplearning4j_tpu.telemetry.registry import get_registry\n"
+            "def setup():\n"
+            "    get_registry().gauge('g_x', 'x')\n"
+        )
+        assert codes(r) == []

@@ -1,0 +1,106 @@
+"""scripts/bench_ledger.py — the BENCH trajectory trend table + gate.
+
+The ROADMAP's "TPU-measured truth" machine gate: rounds fold into one
+table, the newest round gates against a baseline round under per-metric
+tolerances, and hard bounds (lost > 0) fail regardless of history.
+Stdlib-only, driven through the CLI the campaign post-step uses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, name, doc):
+    with open(os.path.join(root, name), "w") as fh:
+        json.dump(doc, fh)
+
+
+def _run(root, *argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_ledger.py"),
+         "--root", str(root), *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+
+
+def _fleet_record(ok=5000, lost=0, invariants_ok=True):
+    return {"bench": "fleet_drill", "ok": invariants_ok,
+            "results": {"requests": {"ok": ok, "lost": lost}},
+            "invariants": {"exactly_one_answer_zero_lost": invariants_ok}}
+
+
+class TestBenchLedger:
+    def test_trend_table_with_delta_vs_baseline(self, tmp_path):
+        _write(tmp_path, "BENCH_serving_r01.json", {
+            "ok": True, "results": {"throughput_rps": 40.0, "lost": 0}})
+        _write(tmp_path, "BENCH_serving_r02.json", {
+            "ok": True, "results": {"throughput_rps": 44.0, "lost": 0}})
+        proc = _run(tmp_path, "--json", str(tmp_path / "ledger.json"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        ledger = json.load(open(tmp_path / "ledger.json"))
+        rows = ledger["families"]["serving"]["rounds"]
+        assert ledger["families"]["serving"]["baseline"] == "r01"
+        delta = rows[1]["metrics"]["throughput_rps"]["delta_vs_r01"]
+        assert abs(delta - 0.10) < 1e-9
+        assert "no regressions" in proc.stdout
+
+    def test_regression_past_tolerance_fails(self, tmp_path):
+        # throughput tolerance is -30%: a 50% drop must gate
+        _write(tmp_path, "BENCH_serving_r01.json", {
+            "ok": True, "results": {"throughput_rps": 40.0, "lost": 0}})
+        _write(tmp_path, "BENCH_serving_r02.json", {
+            "ok": True, "results": {"throughput_rps": 20.0, "lost": 0}})
+        proc = _run(tmp_path)
+        assert proc.returncode == 1
+        assert "REGRESSIONS" in proc.stdout
+        assert "throughput_rps" in proc.stdout
+
+    def test_hard_bound_breach_fails_without_history(self, tmp_path):
+        # a single round with lost > 0 gates on its own
+        _write(tmp_path, "BENCH_fleet_r01.json", _fleet_record(lost=3))
+        proc = _run(tmp_path)
+        assert proc.returncode == 1
+        assert "hard bound" in proc.stdout
+
+    def test_failed_verdict_on_newest_round_fails(self, tmp_path):
+        _write(tmp_path, "BENCH_fleet_r01.json", _fleet_record())
+        _write(tmp_path, "BENCH_fleet_r02.json",
+               _fleet_record(invariants_ok=False))
+        proc = _run(tmp_path)
+        assert proc.returncode == 1
+        assert "failed verdict" in proc.stdout
+
+    def test_baseline_round_pin(self, tmp_path):
+        for rnd, rps in (("r01", 10.0), ("r02", 40.0), ("r03", 39.0)):
+            _write(tmp_path, f"BENCH_serving_{rnd}.json", {
+                "ok": True, "results": {"throughput_rps": rps, "lost": 0}})
+        # vs r01 the latest looks like a 3.9x win; vs r02 it is -2.5%
+        proc = _run(tmp_path, "--baseline", "r02")
+        assert proc.returncode == 0
+        assert "vs r02" in proc.stdout
+
+    def test_unreadable_and_unnamed_files_tolerated(self, tmp_path):
+        _write(tmp_path, "BENCH_fleet_r01.json", _fleet_record())
+        with open(os.path.join(tmp_path, "BENCH_fleet_r02.json"), "w") as fh:
+            fh.write("{broken")
+        _write(tmp_path, "BENCH_BASELINES.json", {"not": "a record"})
+        proc = _run(tmp_path)
+        # the broken newest round has no verdict and no metrics — it
+        # surfaces in the table, the gate reads what exists
+        assert "unreadable" in proc.stderr
+        assert "fleet" in proc.stdout
+
+    def test_no_records_is_an_error(self, tmp_path):
+        proc = _run(tmp_path)
+        assert proc.returncode == 1
+        assert "no BENCH_" in proc.stderr
+
+    def test_gates_green_on_the_repo_itself(self):
+        # the committed BENCH set must pass its own gate — the campaign
+        # post-step runs exactly this
+        proc = _run(REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

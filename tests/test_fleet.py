@@ -832,6 +832,31 @@ class TestFleetDrill:
         assert payload["invariants"]["brownout_only_at_max"]
         assert payload["invariants"]["quiesce_shrinks_to_min"]
 
+    def test_alerts_drill_smoke(self, tmp_path):
+        # the fire-and-resolve story end-to-end against real
+        # subprocesses: SIGKILL -> worker_down with the dead pid + an
+        # exemplar trace id resolvable in the merged /debug/trace,
+        # overload -> latency anomaly, quiesce -> both resolve, zero
+        # false fires in the calm audit windows, zero-lost ledger
+        out = tmp_path / "fleet_alerts.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "fleet_drill.py"),
+             "--smoke", "--alerts", "--output", str(out),
+             "--workdir", str(tmp_path / "work")],
+            cwd=REPO, capture_output=True, text=True, timeout=1500,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, (
+            f"alerts drill breached invariants:\n{proc.stdout[-4000:]}\n"
+            f"{proc.stderr[-2000:]}")
+        payload = json.loads(out.read_text())
+        assert payload["ok"]
+        assert payload["invariants"]["worker_down_fires"]
+        assert payload["invariants"]["exemplar_trace_in_merged_trace"]
+        assert payload["invariants"]["latency_anomaly_fires"]
+        assert payload["invariants"]["all_alerts_resolve"]
+        assert payload["results"]["false_fires"] == 0
+
 
 # ===========================================================================
 # fleet observability: trace propagation, aggregation, SLO, staleness
@@ -1554,3 +1579,181 @@ class TestSpawnFailureBackoff:
         assert slot.ever_routable
         assert slot.spawn_failures == 0  # the ladder reset
         assert slot.next_launch_at is None
+
+
+# ===========================================================================
+# the alert plane on the router (telemetry/alerts.py)
+# ===========================================================================
+
+def _attach_default_alerts(router, **rule_kw):
+    from gan_deeplearning4j_tpu.telemetry.alerts import (
+        AlertManager,
+        default_fleet_rules,
+    )
+
+    mgr = AlertManager(default_fleet_rules(
+        annotate_member=router.annotate_member, **rule_kw))
+    router.attach_alerts(mgr)
+    return mgr
+
+
+class TestAlertPlane:
+    def test_disabled_plane_costs_zero_new_series(self, spawn_worker):
+        from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+        behavior, port = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{port}")
+        r.health_pass()
+        r.health_pass()
+        for _ in range(3):
+            assert _post_sample(r)[0] == 200
+        baseline = get_registry().series_count()
+        for _ in range(3):
+            _post_sample(r)
+            r.health_pass()
+        # no alert manager attached: serving + health traffic allocates
+        # nothing new (the member gauges' series already exist from the
+        # first pass — they are the PR 15 satellite, not alert-gated)
+        assert get_registry().series_count() == baseline
+
+    def test_member_gauges_refreshed_and_removed(self, spawn_worker):
+        behavior, port = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{port}")
+        r.health_pass()  # probe admits
+        r.health_pass()  # scrape lands
+        view = r.alert_view()
+        [up] = view["fleet_member_routable"]["series"]
+        assert up["labels"] == {"worker": "w0"} and up["value"] == 1.0
+        [age] = view["fleet_member_scrape_age_seconds"]["series"]
+        assert age["value"] >= 0.0
+        r.remove_worker("w0")
+        assert r.alert_view()["fleet_member_routable"]["series"] == []
+        assert (r.alert_view()["fleet_member_scrape_age_seconds"]["series"]
+                == [])
+
+    def test_member_signals_prunes_series_recreated_by_a_race(
+            self, spawn_worker):
+        # review-caught: a member_signals pass racing remove_worker can
+        # re-create the retired member's gauge series AFTER the removal
+        # — with the ref gone nothing would ever touch it again, and
+        # worker_down would page forever on a scale-down. The next pass
+        # must reconcile the series set against the live worker set.
+        behavior, port = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{port}")
+        r.health_pass()
+        r.remove_worker("w0")
+        # simulate the race's leftovers: stray series for a gone member
+        r._g_member_routable.labels(worker="w0").set(0.0)
+        r._g_member_scrape_age.labels(worker="w0").set(42.0)
+        r.member_signals()
+        assert r.alert_view()["fleet_member_routable"]["series"] == []
+        assert (r.alert_view()["fleet_member_scrape_age_seconds"]["series"]
+                == [])
+
+    def test_autoscaler_scrape_shares_member_signals(self, spawn_worker):
+        behavior, port = spawn_worker()
+        behavior.queue_depth = 3
+        behavior.in_flight = 2
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{port}")
+        r.health_pass()
+        r.health_pass()
+        mgr = FleetManager(r, "/nonexistent-store-root",
+                           num_workers=1, ports=[port],
+                           spawn=lambda slot, bundle: _FakeProc(),
+                           autoscale=AutoscalerConfig(min_workers=1,
+                                                      max_workers=2))
+        signals = mgr.autoscaler._default_scrape()
+        expected = r.member_signals()
+        assert signals["routable"] == expected["routable"] == 1
+        assert signals["queue_depth"] == expected["queue_depth"] == 3
+        # in_flight is the ROUTER-side count (requests it is running
+        # there now), same as the pre-seam scrape read — none here
+        assert signals["in_flight"] == expected["in_flight"] == 0
+        assert "availability" in signals["burn_rates"]
+
+    def test_worker_down_fires_with_exemplar_and_annotations(
+            self, spawn_worker):
+        behavior, port = spawn_worker()
+        # long reopen: the fake's /healthz still answers while its /v1
+        # path drops connections, so a half-open probe would re-admit it
+        # mid-test and clear the very alert being asserted
+        r = _router(max_attempts=2,
+                    breaker_kwargs={"reopen_after": 30.0})
+        ref = r.add_worker("w0", f"http://127.0.0.1:{port}", pid=4242)
+        _attach_default_alerts(r, probe_interval_s=1.0)
+        r.health_pass()
+        r.health_pass()
+        assert _post_sample(r)[0] == 200  # arms worker_down (healthy once)
+        behavior.mode = "die"  # connection drops mid-request from now on
+        for _ in range(4):
+            _post_sample(r)  # failures: breaker trips + exemplars record
+        assert not ref.routable
+        for _ in range(4):
+            r.health_pass()  # evaluation ticks: pending -> firing
+        [entry] = [e for e in r.alerts.active()
+                   if e["alert"] == "worker_down"]
+        assert entry["state"] == "firing"
+        assert entry["labels"] == {"worker": "w0"}
+        assert entry["annotations"]["pid"] == 4242
+        exemplars = entry["exemplars"]
+        assert exemplars and all(e["worker"] == "w0" for e in exemplars)
+        assert all(e["pid"] == 4242 for e in exemplars)
+        assert all(e["trace_id"] for e in exemplars)
+        # healthz carries the compact block
+        block = r.healthz()["alerts"]
+        assert block["ok"] is False
+        # the failed proxies also burn the availability SLO — both fire
+        assert "worker_down" in {f["alert"] for f in block["firing"]}
+
+    def test_alert_http_routes(self, spawn_worker):
+        import urllib.request
+
+        behavior, port = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{port}")
+        srv = make_router_server(r, port=0)
+        rport = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            # without the plane: an honest 404, not a crash
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}/alerts", timeout=5.0)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 404
+            _attach_default_alerts(r)
+            r.health_pass()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}/alerts",
+                    timeout=5.0) as resp:
+                doc = json.loads(resp.read())
+            assert {x["name"] for x in doc["rules"]} >= {"worker_down"}
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{rport}/alerts?format=prom",
+                    timeout=5.0) as resp:
+                assert b"# TYPE ALERTS gauge" in resp.read()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_fleet_scope_keeps_member_labeled_gauges(self, spawn_worker):
+        # the aggregate setdefault fix end-to-end: the router's
+        # per-member gauges survive the fleet merge with their own
+        # worker labels instead of being relabeled worker="router"
+        behavior, port = spawn_worker()
+        r = _router()
+        r.add_worker("w0", f"http://127.0.0.1:{port}")
+        r.health_pass()
+        r.health_pass()
+        snap = r.fleet_metrics_snapshot()
+        routable = {s["labels"]["worker"]: s["value"]
+                    for s in snap["fleet_member_routable"]["series"]}
+        assert routable == {"w0": 1.0}
+        ages = {s["labels"]["worker"]
+                for s in snap["fleet_member_scrape_age_seconds"]["series"]}
+        assert ages == {"w0"}

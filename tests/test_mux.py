@@ -948,3 +948,50 @@ class TestMergeMemberLabels:
             {"w0": snap}, member_labels={"w0": {"generation": "4"}})
         s = merged["serve_queue_depth"]["series"][0]
         assert s["labels"] == {"generation": "4", "worker": "w0"}
+
+
+# ===========================================================================
+# per-model alerting (telemetry/alerts.py, PR 15)
+# ===========================================================================
+
+class TestMuxAlerts:
+    def _service_with_alerts(self):
+        from gan_deeplearning4j_tpu.telemetry.alerts import (
+            AlertManager,
+            default_mux_rules,
+        )
+
+        reg = fake_registry(budget=4)
+        reg.add("heavy", bundle_path="/h", cost=4.0, weight=0.8)
+        reg.add("lite", bundle_path="/l", cost=1.0, weight=0.2)
+        mgr = AlertManager(default_mux_rules())
+        return MuxService(reg, alerts=mgr), mgr
+
+    def test_model_burn_rule_scopes_per_variant(self):
+        # fail one variant's SLI stream hard: only ITS alert instance
+        # fires — the per-model scoping falls out of the labeled series
+        svc, mgr = self._service_with_alerts()
+        for _ in range(50):
+            svc.tracker_for("heavy").record(False)
+            svc.tracker_for("lite").record(True, 0.01)
+        for _ in range(6):
+            svc.control_tick()
+        firing = [e for e in mgr.active() if e["state"] == "firing"]
+        assert firing, mgr.active()
+        assert {e["labels"].get("model") for e in firing} == {"heavy"}
+        assert {e["alert"] for e in firing} == {"model_slo_burn"}
+        # the surface answers on the mux routing table too
+        code, body = svc.handle("GET", "/alerts")
+        assert code == 200 and body["counts"]["firing"] >= 1
+        code, hz = svc.handle("GET", "/healthz")
+        assert hz["alerts"]["ok"] is False
+        svc.close()
+
+    def test_no_alert_plane_is_a_404_and_zero_cost(self):
+        reg = fake_registry(budget=4)
+        reg.add("only", bundle_path="/o", cost=1.0, weight=1.0)
+        svc = MuxService(reg)
+        code, body = svc.handle("GET", "/alerts")
+        assert code == 404
+        svc.control_tick()  # no evaluator to tick — must not crash
+        svc.close()

@@ -633,6 +633,50 @@ class TestTraceReport:
         assert proc.returncode == 1
         assert "no complete spans" in proc.stderr
 
+    def test_alert_overlay_joins_the_timeline(self, tmp_path):
+        # --alerts: the incident ring lands as instant events on the
+        # same wall-epoch timeline the spans use, the merged artifact
+        # keeps them, and the report summarizes the transitions
+        tr = Tracer(enabled=True)
+        with tr.span("fleet.route", path="/v1/sample"):
+            time.sleep(0.002)
+        trace = tr.dump(str(tmp_path / "trace.json"))
+        alerts = tmp_path / "alerts.json"
+        alerts.write_text(json.dumps({"incidents": [
+            {"t": time.time(), "alert": "worker_down", "severity": "page",
+             "labels": {"worker": "w0"}, "from": "pending", "to": "firing"},
+            {"t": time.time(), "alert": "worker_down", "severity": "page",
+             "labels": {"worker": "w0"}, "from": "firing",
+             "to": "resolved"},
+        ]}))
+        merged = tmp_path / "merged.json"
+        proc = self._run(trace, "--alerts", str(alerts),
+                         "--merge-out", str(merged),
+                         "--json", str(tmp_path / "report.json"))
+        assert proc.returncode == 0, proc.stderr
+        assert "alert overlay:" in proc.stdout
+        assert "pending -> firing" in proc.stdout
+        with open(tmp_path / "report.json") as fh:
+            report = json.load(fh)
+        assert report["alerts"] == {"transitions": 2,
+                                    "by_state": {"firing": 1,
+                                                 "resolved": 1}}
+        with open(merged) as fh:
+            events = json.load(fh)["traceEvents"]
+        assert sum(1 for e in events
+                   if str(e.get("name", "")).startswith("alert:")) == 2
+
+    def test_alert_overlay_rejects_non_alert_file(self, tmp_path):
+        tr = Tracer(enabled=True)
+        with tr.span("x"):
+            pass
+        trace = tr.dump(str(tmp_path / "trace.json"))
+        bad = tmp_path / "notalerts.json"
+        bad.write_text('{"rules": []}\n')
+        proc = self._run(trace, "--alerts", str(bad))
+        assert proc.returncode == 1
+        assert "incidents" in proc.stderr
+
     def test_malformed_trace_fails_the_gate(self, tmp_path):
         path = tmp_path / "broken.json"
         path.write_text("{not json\n")
@@ -850,6 +894,66 @@ class TestFleetAggregate:
         assert got["sum"] == pytest.approx(float(values.sum()))
         for key in ("p50", "p95", "p99"):
             assert got[key] == want[key]
+
+    def test_histogram_merge_with_empty_samples_member(self):
+        """A truncated scrape: one member reports count/sum but an EMPTY
+        samples list. The merge must pool the non-empty members'
+        samples for the percentiles (not crash, not skew toward zero)
+        while count/sum stay the exact fleet totals."""
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        full = {"lat": {"type": "histogram", "help": "", "series": [
+            {"labels": {}, "count": 4, "sum": 4.0,
+             "samples": [0.5, 1.0, 1.0, 1.5]}]}}
+        truncated = {"lat": {"type": "histogram", "help": "", "series": [
+            {"labels": {}, "count": 3, "sum": 30.0, "samples": []}]}}
+        merged = merge_snapshots({"w0": full, "w1": truncated})
+        [series] = merged["lat"]["series"]
+        assert series["count"] == 7        # totals are exact
+        assert series["sum"] == 34.0
+        # percentiles describe the pooled NON-EMPTY samples: w1's much
+        # slower (but unsampled) traffic cannot drag them to zero or NaN
+        assert series["p50"] == 1.0
+        assert series["p99"] == 1.5
+
+    def test_histogram_merge_all_members_sampleless(self):
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        part = {"lat": {"type": "histogram", "help": "", "series": [
+            {"labels": {}, "count": 2, "sum": 6.0, "samples": []}]}}
+        merged = merge_snapshots({"w0": part, "w1": part})
+        [series] = merged["lat"]["series"]
+        assert series["count"] == 4 and series["sum"] == 12.0
+        # no samples anywhere: no percentile keys, not a crash and not 0s
+        assert not any(k.startswith("p") for k in series
+                       if k not in ("labels",))
+
+    def test_histogram_merge_missing_samples_key(self):
+        # a member snapshotted without include_samples (samples key
+        # absent entirely) contributes count/sum only
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        with_samples = {"lat": {"type": "histogram", "help": "", "series": [
+            {"labels": {}, "count": 2, "sum": 2.0, "samples": [0.9, 1.1]}]}}
+        without = {"lat": {"type": "histogram", "help": "", "series": [
+            {"labels": {}, "count": 5, "sum": 5.0}]}}
+        merged = merge_snapshots({"w0": with_samples, "w1": without})
+        [series] = merged["lat"]["series"]
+        assert series["count"] == 7 and series["sum"] == 7.0
+        assert series["p50"] == 0.9
+
+    def test_gauge_keeps_its_own_worker_label(self):
+        # the router's per-member gauges (fleet_member_routable/...)
+        # already NAME the member each fact describes: the merge must
+        # fill the worker label only where it is missing, never relabel
+        from gan_deeplearning4j_tpu.telemetry.aggregate import merge_snapshots
+
+        part = {"fleet_member_routable": {"type": "gauge", "help": "",
+                                          "series": [
+            {"labels": {"worker": "w7"}, "value": 0.0}]}}
+        merged = merge_snapshots({"router": part})
+        [series] = merged["fleet_member_routable"]["series"]
+        assert series["labels"] == {"worker": "w7"}
 
     def test_partial_fleet_scrape_degrades_to_labeled_gap(self):
         from gan_deeplearning4j_tpu.telemetry.aggregate import (
