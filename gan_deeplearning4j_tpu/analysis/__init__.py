@@ -13,7 +13,10 @@ chains and ``functools.partial``, host-callback taint) — and phase 2 runs
 the rules with that index on every module, so donation misuse through
 ``functools.partial``/import indirection, callbacks reached from timed
 regions, and axis arities of functions defined a module away are all
-visible (JG007–JG011 join PR 1's JG001–JG006).
+visible (JG007–JG011 join PR 1's JG001–JG006). A lazily-built
+**concurrency index** (:mod:`.concurrency`: thread entry points,
+per-method attribute accesses with held-lock sets, lock-acquisition
+sequences) extends phase 1 for the thread-safety rules JG024–JG026.
 
 Deliberately jax-free and stdlib-only: the analyzer must run on the parent
 side of the bench architecture (bench.py's parent never imports jax — a dead

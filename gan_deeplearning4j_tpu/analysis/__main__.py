@@ -36,6 +36,25 @@ from gan_deeplearning4j_tpu.analysis import engine
 from gan_deeplearning4j_tpu.analysis.rules import RULES
 
 
+def _render_profile(report, rules) -> str:
+    """Wall-time table for --profile: phases first, then every rule that
+    ran, slowest first. Times are wall seconds of this run; the phase-1
+    indexes built lazily by a rule (the concurrency index under JG024)
+    are charged to the rule that triggered the build."""
+    prof = report.profile or {"phases": {}, "rules": {}}
+    names = {r.code: r.name for r in rules}
+    lines = ["# jaxlint --profile (wall seconds)"]
+    phases = prof.get("phases", {})
+    for key in ("parse", "index", "rules"):
+        if key in phases:
+            lines.append(f"#   phase {key:<8s} {phases[key]:8.3f}s")
+    per_rule = prof.get("rules", {})
+    for code in sorted(per_rule, key=lambda c: (-per_rule[c], c)):
+        lines.append(f"#   {code} {names.get(code, '?'):<34s} "
+                     f"{per_rule[code]:8.3f}s")
+    return "\n".join(lines)
+
+
 def _emit(report, fmt: str, rules, baseline) -> None:
     if fmt == "json":
         print(json.dumps(report.to_json(), indent=2))
@@ -84,6 +103,9 @@ def main(argv=None) -> int:
                         "remaining active finding (requires --justification)")
     p.add_argument("--justification", default=None,
                    help="human reason recorded by --fix-suppress")
+    p.add_argument("--profile", action="store_true",
+                   help="print a per-phase/per-rule wall-time table to "
+                        "stderr (the report itself is unchanged)")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -177,6 +199,8 @@ def main(argv=None) -> int:
             print(f"jaxlint: not mechanically fixable: {s}", file=sys.stderr)
         report = run()  # re-analyze: the output reflects the tree on disk
 
+    if args.profile:
+        print(_render_profile(report, rules), file=sys.stderr)
     _emit(report, args.format, rules, baseline)
     return 0 if report.gate_ok else 1
 

@@ -17,6 +17,7 @@ import io
 import json
 import os
 import re
+import time
 import tokenize
 from typing import Iterable, List, Optional
 
@@ -117,6 +118,11 @@ class Report:
     stale_baseline: List[dict]  # baseline entries that matched nothing
     files: int
     warnings: List[str] = dataclasses.field(default_factory=list)
+    # wall-time breakdown: {"phases": {...}, "rules": {code: seconds}}.
+    # Deliberately NOT part of to_json()/render_text() — timings vary run
+    # to run and every emission format must be byte-stable for identical
+    # inputs. The CLI renders it separately under --profile.
+    profile: Optional[dict] = None
 
     @property
     def clean(self) -> bool:
@@ -338,13 +344,18 @@ def changed_files(root: Optional[str] = None, base: str = "HEAD") -> List[str]:
     return sorted(files)
 
 
-def _run_rules(mod: SourceModule, rules) -> List[tuple]:
+def _run_rules(mod: SourceModule, rules,
+               rule_times: Optional[dict] = None) -> List[tuple]:
     """[(finding, node)] for one module, rule errors converted to findings
-    (an analyzer crash must be visible, not a silent pass)."""
+    (an analyzer crash must be visible, not a silent pass). ``rule_times``
+    accumulates per-rule wall seconds across modules; a rule that lazily
+    builds a shared index (the concurrency index under JG024) is charged
+    for that build on its first run — the honest attribution."""
     out = []
     for rule in rules:
         if mod.is_test and getattr(rule, "skip_tests", False):
             continue
+        t0 = time.perf_counter()
         try:
             for item in rule.check(mod):
                 if isinstance(item, tuple):
@@ -366,6 +377,9 @@ def _run_rules(mod: SourceModule, rules) -> List[tuple]:
                 ),
                 None,
             ))
+        if rule_times is not None:
+            rule_times[rule.code] = (rule_times.get(rule.code, 0.0)
+                                     + time.perf_counter() - t0)
     return out
 
 
@@ -385,9 +399,13 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
     active, suppressed, baselined = [], [], []
     warnings: List[str] = []
     seen = set()  # scope overlap can surface one defect twice — keep first
-    mods = list(mods)
+    t0 = time.perf_counter()
+    mods = list(mods)  # consuming the generator = reading + parsing
+    t_parse = time.perf_counter() - t0
     parsed = [m for m in mods if isinstance(m, SourceModule)]
+    t0 = time.perf_counter()
     index = _project.build_index(parsed)
+    t_index = time.perf_counter() - t0
     mod_by_path = {}
     for m in parsed:
         m.project = index
@@ -401,12 +419,14 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
                     f"{code!r} — it suppresses nothing; check for a typo"
                 )
     files = 0
+    rule_times: dict = {}
+    t0 = time.perf_counter()
     for mod in mods:
         files += 1
         if isinstance(mod, Finding):  # parse failure
             active.append(mod)
             continue
-        for finding, node in _run_rules(mod, rules):
+        for finding, node in _run_rules(mod, rules, rule_times):
             key = (finding.code, finding.path, finding.line, finding.col)
             if key in seen:
                 continue
@@ -433,9 +453,23 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
         and (not e.get("path") or e["path"] in analyzed)
         and (not e.get("rule") or e["rule"] in rule_codes)
     ]
-    active.sort(key=lambda f: (f.path, f.line, f.code))
+    t_rules = time.perf_counter() - t0
+    # Deterministic emission order for EVERY partition, not just active:
+    # findings surface in module-iteration order, which depends on how the
+    # caller enumerated paths — two runs over the same tree must render
+    # byte-identical text/JSON/SARIF regardless.
+    order = lambda f: (f.path, f.line, f.code)  # noqa: E731
+    active.sort(key=order)
+    suppressed.sort(key=order)
+    baselined.sort(key=order)
+    warnings.sort()
+    stale.sort(key=lambda e: (e.get("path") or "", e["fingerprint"]))
+    profile = {
+        "phases": {"parse": t_parse, "index": t_index, "rules": t_rules},
+        "rules": rule_times,
+    }
     return Report(active, suppressed, baselined, stale, files,
-                  warnings=warnings)
+                  warnings=warnings, profile=profile)
 
 
 def analyze_paths(paths, rules=None, baseline=None, root=None) -> Report:

@@ -173,11 +173,23 @@ class ProjectIndex:
         self._taint_cache: Dict[str, bool] = {}
         self._io_taint_cache: Dict[str, bool] = {}
         self._spawn_taint_cache: Dict[str, bool] = {}
+        self._concurrency = None
         for mod in srcmods:
             self._index_module(mod)
         # second pass: module-level donators that need every summary in place
         for info in self.modules.values():
             self._collect_donators(info)
+
+    @property
+    def concurrency(self):
+        """The thread-safety extension (:mod:`.concurrency`), built lazily
+        on first use so runs that exclude JG024–JG026 pay nothing for it;
+        per-path summaries are cached inside the returned index."""
+        if self._concurrency is None:
+            from gan_deeplearning4j_tpu.analysis import concurrency as _conc
+
+            self._concurrency = _conc.build(self)
+        return self._concurrency
 
     # -- construction -------------------------------------------------------
     def _index_module(self, mod) -> None:

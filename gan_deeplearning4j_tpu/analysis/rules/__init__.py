@@ -53,6 +53,15 @@ from gan_deeplearning4j_tpu.analysis.rules.mux_sharing import (
 from gan_deeplearning4j_tpu.analysis.rules.alert_metrics import (
     UnknownMetricInAlertRule,
 )
+from gan_deeplearning4j_tpu.analysis.rules.shared_state import (
+    UnguardedSharedMutableState,
+)
+from gan_deeplearning4j_tpu.analysis.rules.lock_order import (
+    LockOrderInversion,
+)
+from gan_deeplearning4j_tpu.analysis.rules.lock_blocking import (
+    BlockingCallUnderLock,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -78,6 +87,9 @@ RULES = [
     UnboundedRespawnLoop(),
     CrossGenerationEngineSharing(),
     UnknownMetricInAlertRule(),
+    UnguardedSharedMutableState(),
+    LockOrderInversion(),
+    BlockingCallUnderLock(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

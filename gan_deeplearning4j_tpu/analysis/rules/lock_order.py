@@ -1,0 +1,123 @@
+"""JG025 — lock-order inversion (potential deadlock).
+
+With PRs 11–15 every plane holds its own lock, and several hold two (the
+fleet manager's ``_lock``/``_cycle_lock``/``_supervise_lock``, the mux
+service's registry + splitter pair). Two threads that take the same two
+locks in opposite orders deadlock the first time their critical sections
+overlap — a hazard no drill reproduces reliably, because the window is a
+few instructions wide. The classic static check: build the
+lock-acquisition graph (edge A→B when B is acquired while A is held) and
+flag cycles.
+
+The model (phase-1 concurrency index): per module, every ``with <lock>:``
+acquisition contributes edges from each lock already held (lexical
+nesting), plus one resolved same-class call hop — ``with self._a:
+self._helper()`` where ``_helper`` does ``with self._b:`` contributes
+A→B at the call site. Lock identities are class-qualified for ``self``
+locks (``Manager._lock``), source text for module-level and foreign locks
+(``_capture_lock``, ``registry.lock``); condition variables constructed
+over a lock alias to that lock. A cycle in the per-module graph is
+reported once, at the edge that closes it, naming the full cycle and
+where each edge was taken.
+
+Not flagged: re-acquiring the same canonical lock (RLock re-entrancy and
+Condition-over-lock aliasing are not inversions); consistent global
+orderings (A→B twice is one edge); acquisition sequences in different
+modules (documented false negative: cross-plane inversions need lock ids
+that unify across classes, which static ``self`` analysis cannot give —
+the drills own that). ``.acquire()``/``.release()`` outside ``with`` is
+likewise invisible.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class LockOrderInversion:
+    code = "JG025"
+    name = "lock-order-inversion"
+    summary = ("two locks acquired in opposite orders on different paths — "
+               "a potential deadlock")
+    skip_tests = True
+
+    def check(self, mod):
+        if mod.project is None:
+            return
+        # edge (A, B) -> first (node, method) that took B while holding A
+        edges: Dict[Tuple[str, str], tuple] = {}
+
+        def add_edge(held, lock, node, where):
+            for h in held:
+                if h != lock and (h, lock) not in edges:
+                    edges[(h, lock)] = (node, where)
+
+        for cc in mod.project.concurrency.classes(mod.path):
+            for mc in cc.methods.values():
+                for acq in mc.acquisitions:
+                    add_edge(acq.held_before, acq.lock, acq.node,
+                             f"{cc.name}.{mc.name}")
+                for call in mc.self_calls:
+                    if not call.held:
+                        continue
+                    callee = cc.methods.get(call.callee)
+                    if callee is None:
+                        continue
+                    # one call hop: locks the callee acquires are taken
+                    # while the caller's held set is still held
+                    for acq in callee.acquisitions:
+                        add_edge(call.held, acq.lock, call.node,
+                                 f"{cc.name}.{mc.name} -> {call.callee}")
+
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+        for a in adj:
+            adj[a].sort()
+
+        seen_cycles = set()
+        for (a, b) in sorted(
+                edges, key=lambda e: (edges[e][0].lineno, e)):
+            path = self._path(adj, b, a)
+            if path is None:
+                continue
+            cycle = [a] + path  # a -> b -> ... -> a
+            key = frozenset(cycle)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            node, where = edges[(a, b)]
+            hops = []
+            for i in range(len(cycle) - 1):
+                e = edges.get((cycle[i], cycle[i + 1]))
+                loc = (f"{mod.path}:{e[0].lineno} in {e[1]}"
+                       if e else "resolved hop")
+                hops.append(
+                    f"`{cycle[i]}` -> `{cycle[i + 1]}` ({loc})")
+            yield mod.finding(
+                self.code,
+                f"lock-order inversion: taking `{b}` while holding `{a}` "
+                f"(in {where}) closes the cycle "
+                f"{' -> '.join(f'`{c}`' for c in cycle)} — two threads "
+                f"entering these regions concurrently can deadlock; pick "
+                f"one global acquisition order [{'; '.join(hops)}]",
+                node,
+            ), node
+
+    @staticmethod
+    def _path(adj, start: str, goal: str) -> Optional[List[str]]:
+        """Deterministic DFS path start -> ... -> goal, as a node list
+        ending at goal (start included first), else None."""
+        stack = [(start, [start])]
+        visited = set()
+        while stack:
+            cur, path = stack.pop()
+            if cur == goal:
+                return path
+            if cur in visited:
+                continue
+            visited.add(cur)
+            for nxt in reversed(adj.get(cur, [])):
+                if nxt not in visited:
+                    stack.append((nxt, path + [nxt]))
+        return None

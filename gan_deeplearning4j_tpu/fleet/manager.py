@@ -287,11 +287,15 @@ class FleetManager:
                         f"no valid serving generation appeared in "
                         f"{self.store.root} within {boot_wait:.0f}s")
                 time.sleep(0.25)
-        self.generation = candidate.generation
-        self.bundle_path = candidate.path
-        self._feature_bundle = candidate.path
-        self._g_generation.set(-1 if self.generation is None
-                               else self.generation)
+        # under the cycle lock even though the supervise thread starts
+        # below: a concurrently-forced poll_now(wait=True) from another
+        # thread must never observe generation set but bundle_path not
+        with self._cycle_lock:
+            self.generation = candidate.generation
+            self.bundle_path = candidate.path
+            self._feature_bundle = candidate.path
+        self._g_generation.set(-1 if candidate.generation is None
+                               else candidate.generation)
         for slot in self.slots:
             self._launch(slot, candidate.path)
         self.router.start_health_loop()
@@ -316,7 +320,11 @@ class FleetManager:
         with self._lock:
             body = {
                 "state": self._state,
-                "generation": self.generation,
+                # deliberately not under _cycle_lock: that lock is held
+                # for minutes during a roll and status() is the
+                # observability endpoint that must stay responsive then;
+                # a generation stale by one roll is an acceptable read
+                "generation": self.generation,  # jaxlint: disable=JG024 (status must not block on the cycle lock)
                 "rolls": self._rolls,
                 "rejected": self._rejected,
                 "last_error": self._last_error,
@@ -472,13 +480,19 @@ class FleetManager:
         wedges is bounded by ``warm_timeout`` supervision and a boot
         that dies goes through the spawn-failure backoff. Returns the
         new slot, or None when there is no bundle to spawn from."""
-        if self._stop.is_set() or self.bundle_path is None:
+        # one snapshot, not two reads: Autoscaler._apply holds _cycle_lock
+        # (non-blocking acquire, a cross-class seam the static index cannot
+        # see) for every resize, so the bundle cannot roll mid-call — and
+        # the single read also kills the check-then-use window for any
+        # future lockless caller
+        bundle = self.bundle_path  # jaxlint: disable=JG024 (resize runs under _cycle_lock via Autoscaler._apply)
+        if self._stop.is_set() or bundle is None:
             return None
         with self._lock:
             idx = self._next_slot_idx
             self._next_slot_idx += 1
         slot = WorkerSlot(f"w{idx}", _free_port(self.host), self.host)
-        self._launch(slot, self.bundle_path)
+        self._launch(slot, bundle)
         with self._lock:
             self.slots.append(slot)
             self.events.append({"event": "scale_up", "worker": slot.id,

@@ -645,7 +645,12 @@ class ServingEngine:
                 if exe is not None:
                     chunk = rows_list[ri][roff:roff + slab]
                     dev = jax.device_put(chunk, self._batch_sharding)
-                    parts.append((exe(self._params_mesh[role], dev),
+                    # _params_mesh is published before _bulk_executable
+                    # returns non-None (both written under _compile_lock),
+                    # so this lockless hot-path read never sees a partial
+                    # value; taking _compile_lock here would park dispatch
+                    # behind multi-second XLA compiles
+                    parts.append((exe(self._params_mesh[role], dev),  # jaxlint: disable=JG024 (publish-ordered behind _bulk_executable)
                                   slab, None, None))
                     roff += slab
                     remaining -= slab

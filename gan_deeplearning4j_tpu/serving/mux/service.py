@@ -162,6 +162,12 @@ class MuxService:
                 self._trackers[name] = tracker
         return tracker
 
+    def _trackers_snapshot(self) -> list:
+        """Sorted (name, tracker) pairs, snapshotted under the lock so
+        healthz never iterates the dict while tracker_for is inserting."""
+        with self._lock:
+            return sorted(self._trackers.items())
+
     # -- brownout ---------------------------------------------------------
     @property
     def brownout_level(self) -> int:
@@ -343,7 +349,7 @@ class MuxService:
                          "shedding": sorted(self._shed_set())},
             "ramp": None if ramp is None else ramp.snapshot(),
             "slo": {name: tracker.snapshot()
-                    for name, tracker in sorted(self._trackers.items())},
+                    for name, tracker in self._trackers_snapshot()},
         }
         if self.alerts is not None:
             body["alerts"] = self.alerts.health_block()

@@ -149,7 +149,11 @@ class LoadGenerator:
         self.stop.set()
         for t in self._threads:
             t.join(timeout=self.timeout + 5.0)
-        return dict(self.counts)
+        # the joins are bounded — a straggler stuck in a slow request may
+        # still be incrementing, so read under the same lock the workers
+        # write under
+        with self._lock:
+            return dict(self.counts)
 
 
 class FleetMonitor:

@@ -7,9 +7,15 @@
 #                                        #   the pre-commit shape
 #   scripts/lint_gate.sh --full          # the whole tier-1 target set —
 #                                        #   what tests/test_analysis.py's
-#                                        #   TestTreeIsClean enforces
+#                                        #   TestTreeIsClean enforces; also
+#                                        #   FAILS (exit 1) on stale baseline
+#                                        #   entries — a fixed bug must leave
+#                                        #   analysis/_baseline.json, not
+#                                        #   haunt it (--prune-baseline)
 #   LINT_BASE=main scripts/lint_gate.sh  # changed vs merge-base with main
 #   LINT_FORMAT=sarif scripts/lint_gate.sh --full > lint.sarif  # CI annotators
+#   LINT_PROFILE=1 scripts/lint_gate.sh --full  # per-phase/per-rule wall-time
+#                                        #   table on stderr (report unchanged)
 #   scripts/lint_gate.sh --mux           # the serving/mux seam only, with
 #                                        #   the two engine-sharing rules
 #                                        #   (JG016 swap seam, JG022
@@ -22,17 +28,20 @@
 cd "$(dirname "$0")/.." || exit 2
 TARGETS=(gan_deeplearning4j_tpu bench.py scripts)
 FORMAT="${LINT_FORMAT:-text}"
+EXTRA=()
+[ -n "${LINT_PROFILE:-}" ] && EXTRA+=(--profile)
 if [ "$1" = "--full" ]; then
   shift
   exec python -m gan_deeplearning4j_tpu.analysis "${TARGETS[@]}" \
-    --format "$FORMAT" "$@"
+    --format "$FORMAT" "${EXTRA[@]}" "$@"
 fi
 if [ "$1" = "--mux" ]; then
   shift
   exec python -m gan_deeplearning4j_tpu.analysis \
     gan_deeplearning4j_tpu/serving gan_deeplearning4j_tpu/deploy \
     gan_deeplearning4j_tpu/fleet \
-    --rules JG016,JG022 --format "$FORMAT" "$@"
+    --rules JG016,JG022 --format "$FORMAT" "${EXTRA[@]}" "$@"
 fi
 exec python -m gan_deeplearning4j_tpu.analysis "${TARGETS[@]}" \
-  --changed-only --diff-base "${LINT_BASE:-HEAD}" --format "$FORMAT" "$@"
+  --changed-only --diff-base "${LINT_BASE:-HEAD}" --format "$FORMAT" \
+  "${EXTRA[@]}" "$@"

@@ -26,6 +26,13 @@ if ! bash scripts/lint_gate.sh --full > lint_gate.log 2>&1; then
   echo "$(date +%H:%M:%S) jaxlint gate failed — campaign aborted (see lint_gate.log)" >> tpu_poller.log
   exit 1
 fi
+# Auditable artifact: the SARIF snapshot of the gate the campaign ran
+# under lands next to the BENCH records, so "what did the analyzer say
+# about the exact tree that produced these numbers" has a durable answer.
+mkdir -p artifacts
+LINT_FORMAT=sarif bash scripts/lint_gate.sh --full \
+  > artifacts/lint_gate.sarif 2>> tpu_poller.log \
+  || echo "$(date +%H:%M:%S) sarif artifact emission failed (gate already passed — continuing)" >> tpu_poller.log
 # Serving smoke (CPU, small fixed shape): the campaign ships artifacts a
 # serving replica must be able to load and serve — refuse to start if the
 # serve path regressed (zero-lost / bounded-compile / no-serve-time-compile

@@ -3162,3 +3162,590 @@ class TestUnknownMetricInAlertRule:
             "    get_registry().gauge('g_x', 'x')\n"
         )
         assert codes(r) == []
+
+
+# ===========================================================================
+# JG024 — unguarded shared mutable state
+# ===========================================================================
+
+class TestUnguardedSharedMutableState:
+    def test_true_positive_unguarded_read_escape(self):
+        # the healthz shape: the loop thread mutates counts under the lock,
+        # the public snapshot reads it bare — a torn dict walk waiting
+        r = run(
+            "import threading\n"
+            "class Sampler:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.counts = {}\n"
+            "        self._thread = None\n"
+            "    def start(self):\n"
+            "        self._thread = threading.Thread(target=self._loop,\n"
+            "                                        daemon=True)\n"
+            "        self._thread.start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.counts['a'] = self.counts.get('a', 0) + 1\n"
+            "        with self._lock:\n"
+            "            self.counts['b'] = 1\n"
+            "    def snapshot(self):\n"
+            "        return dict(self.counts)\n"
+        )
+        assert codes(r) == ["JG024"]
+        msg = r.active[0].message
+        assert "snapshot" in msg and "counts" in msg and "_lock" in msg
+
+    def test_true_positive_unguarded_store_escape(self):
+        # the reload shape: the rebind escapes the lock the readers use
+        r = run(
+            "import threading\n"
+            "class Holder:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.state['ticks'] = self.state.get('ticks', 0) + 1\n"
+            "    def reset(self):\n"
+            "        self.state = {}\n"
+        )
+        assert codes(r) == ["JG024"]
+        assert "mutates" in r.active[0].message
+
+    def test_true_negative_all_accesses_guarded(self):
+        r = run(
+            "import threading\n"
+            "class Sampler:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.counts = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.counts['a'] = self.counts.get('a', 0) + 1\n"
+            "        with self._lock:\n"
+            "            self.counts['b'] = 1\n"
+            "    def snapshot(self):\n"
+            "        with self._lock:\n"
+            "            return dict(self.counts)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_never_locked_attribute(self):
+        # an Event-style atomic flag: no lock discipline exists, so there
+        # is nothing to escape — flagging it would just demand ceremony
+        r = run(
+            "import threading\n"
+            "class Flag:\n"
+            "    def __init__(self):\n"
+            "        self.hits = 0\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        self.hits += 1\n"
+            "    def read(self):\n"
+            "        return self.hits\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_no_threads_spawned(self):
+        # same lock/escape shape, but nothing concurrent ever runs
+        r = run(
+            "import threading\n"
+            "class Seq:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.counts = {}\n"
+            "    def tick(self):\n"
+            "        with self._lock:\n"
+            "            self.counts['a'] = 1\n"
+            "        with self._lock:\n"
+            "            self.counts['b'] = 2\n"
+            "    def snapshot(self):\n"
+            "        return dict(self.counts)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_read_only_outside_init(self):
+        # config, not state: assigned once at construction, only read after
+        r = run(
+            "import threading\n"
+            "class Cfg:\n"
+            "    def __init__(self, n):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.limit = n\n"
+            "        self.seen = []\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.seen.append(self.limit)\n"
+            "        with self._lock:\n"
+            "            self.seen.append(0)\n"
+            "    def read(self):\n"
+            "        return self.limit\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_caller_holds_the_lock_convention(self):
+        # a private helper mutates bare, but every in-class call site holds
+        # the lock — call-site guard propagation must see through it
+        r = run(
+            "import threading\n"
+            "class Conv:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.counts = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._bump('a')\n"
+            "    def record(self):\n"
+            "        with self._lock:\n"
+            "            self._bump('b')\n"
+            "    def _bump(self, k):\n"
+            "        self.counts[k] = self.counts.get(k, 0) + 1\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_http_handler_instances_are_per_request(self):
+        # BaseHTTPRequestHandler subclasses get a fresh instance per
+        # request: self attrs are not shared across threads
+        r = run(
+            "import threading\n"
+            "from http.server import BaseHTTPRequestHandler\n"
+            "class H(BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        self.hits = getattr(self, 'hits', 0) + 1\n"
+            "        self.wfile.write(b'ok')\n"
+        )
+        assert codes(r) == []
+
+    def test_suppression_on_the_escape_line_suppresses_exactly_it(self):
+        # satellite: the disable comment must silence the one access it
+        # annotates, not the attribute — a second escape still fires
+        src = (
+            "import threading\n"
+            "class Sampler:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.counts = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.counts['a'] = self.counts.get('a', 0) + 1\n"
+            "        with self._lock:\n"
+            "            self.counts['b'] = 1\n"
+            "    def snapshot(self):\n"
+            "        return dict(self.counts)  # jaxlint: disable=JG024 (read is advisory)\n"
+            "    def drain(self):\n"
+            "        return self.counts.pop('a', None)\n"
+        )
+        r = run(src)
+        assert codes(r) == ["JG024"]
+        assert "drain" in r.active[0].message
+        assert len(r.suppressed) == 1
+        assert "snapshot" in r.suppressed[0].message
+
+
+# ===========================================================================
+# JG025 — lock-order inversion
+# ===========================================================================
+
+class TestLockOrderInversion:
+    def test_true_positive_opposite_nesting(self):
+        r = run(
+            "import threading\n"
+            "class Pair:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                return 1\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                return 2\n"
+        )
+        assert codes(r) == ["JG025"]
+        msg = r.active[0].message
+        assert "Pair._a" in msg and "Pair._b" in msg and "deadlock" in msg
+
+    def test_true_positive_inversion_through_call_hop(self):
+        # one edge is only visible through a resolved same-class call:
+        # one() holds _a and calls _helper(), which takes _b
+        r = run(
+            "import threading\n"
+            "class Pair:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            self._helper()\n"
+            "    def _helper(self):\n"
+            "        with self._b:\n"
+            "            return 1\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            with self._a:\n"
+            "                return 2\n"
+        )
+        assert codes(r) == ["JG025"]
+
+    def test_true_positive_module_level_locks(self):
+        r = run(
+            "import threading\n"
+            "IO_LOCK = threading.Lock()\n"
+            "NET_LOCK = threading.Lock()\n"
+            "def one():\n"
+            "    with IO_LOCK:\n"
+            "        with NET_LOCK:\n"
+            "            return 1\n"
+            "def two():\n"
+            "    with NET_LOCK:\n"
+            "        with IO_LOCK:\n"
+            "            return 2\n"
+        )
+        assert codes(r) == ["JG025"]
+
+    def test_true_negative_consistent_global_order(self):
+        r = run(
+            "import threading\n"
+            "class Pair:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                return 1\n"
+            "    def two(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                return 2\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_reentrant_same_lock(self):
+        # RLock re-entrancy is not an inversion: a self-edge is no cycle
+        r = run(
+            "import threading\n"
+            "class Re:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "    def outer(self):\n"
+            "        with self._lock:\n"
+            "            with self._lock:\n"
+            "                return 1\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_condition_over_lock_is_an_alias(self):
+        # Condition(self._lock) IS self._lock: nesting them is re-entry
+        # (by design: notify under the same lock wait released), not an
+        # A->B edge
+        r = run(
+            "import threading\n"
+            "class CV:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "    def put(self):\n"
+            "        with self._lock:\n"
+            "            with self._cv:\n"
+            "                self._cv.notify()\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_unnested_acquisitions(self):
+        r = run(
+            "import threading\n"
+            "class Seq:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def one(self):\n"
+            "        with self._a:\n"
+            "            pass\n"
+            "        with self._b:\n"
+            "            pass\n"
+            "    def two(self):\n"
+            "        with self._b:\n"
+            "            pass\n"
+            "        with self._a:\n"
+            "            pass\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG026 — blocking call under a lock
+# ===========================================================================
+
+class TestBlockingCallUnderLock:
+    def test_true_positive_sleep_under_lock(self):
+        r = run(
+            "import threading\n"
+            "import time\n"
+            "class Poller:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(0.5)\n"
+            "            self.state['t'] = 1\n"
+        )
+        assert codes(r) == ["JG026"]
+        msg = r.active[0].message
+        assert "time.sleep" in msg and "_lock" in msg
+
+    def test_true_positive_network_call_under_lock(self):
+        # bounded (JG017-clean) but still parked under the lock every
+        # request thread turns around on
+        r = run(
+            "import threading\n"
+            "import urllib.request\n"
+            "class Prober:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            urllib.request.urlopen('http://x/healthz', timeout=2)\n"
+        )
+        assert codes(r) == ["JG026"]
+
+    def test_true_positive_join_through_call_hop(self):
+        # the deadlock shape: stop() holds the lock and joins the worker
+        # (via a helper) while the worker may be parked on the same lock
+        r = run(
+            "import threading\n"
+            "class Mgr:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._thread = threading.Thread(target=self._loop,\n"
+            "                                        daemon=True)\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+            "    def stop(self):\n"
+            "        with self._lock:\n"
+            "            self._reap()\n"
+            "    def _reap(self):\n"
+            "        self._thread.join(timeout=5.0)\n"
+        )
+        assert codes(r) == ["JG026"]
+        assert "_reap" in r.active[0].message
+
+    def test_true_negative_snapshot_then_block_outside(self):
+        # the correct idiom the fleet manager uses: copy under the lock,
+        # wait outside it
+        r = run(
+            "import threading\n"
+            "import time\n"
+            "class Poller:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.state = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            snap = dict(self.state)\n"
+            "        time.sleep(0.5)\n"
+            "        return snap\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_no_threads(self):
+        # single-threaded blocking under a lock is just I/O — nothing
+        # contends
+        r = run(
+            "import threading\n"
+            "import time\n"
+            "class Seq:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def step(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(0.1)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_condition_wait_releases_the_lock(self):
+        r = run(
+            "import threading\n"
+            "class Q:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition(self._lock)\n"
+            "        self.items = []\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._cv:\n"
+            "            self._cv.wait(timeout=1.0)\n"
+            "            self.items.append(1)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_str_join_is_not_thread_join(self):
+        r = run(
+            "import threading\n"
+            "class Fmt:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.parts = []\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.parts.append(', '.join(['a', 'b']))\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# Satellites: deterministic emission, --profile, gate staleness
+# ===========================================================================
+
+class TestDeterministicEmission:
+    SOURCES = {
+        "fx/b_mod.py": "def g(y):\n    assert y\n    return y\n",
+        "fx/a_mod.py": (
+            "def f(x):\n"
+            "    assert x  # jaxlint: disable=JG003 (fixture)\n"
+            "    assert x + 1\n"
+            "    return x\n"
+        ),
+    }
+
+    def _analyze(self, order):
+        from gan_deeplearning4j_tpu.analysis import engine
+
+        mods = [engine.parse_module(self.SOURCES[p], p) for p in order]
+        baseline = [{"fingerprint": "deadbeefdeadbeef", "rule": "JG003",
+                     "path": "fx/a_mod.py", "justification": "was fixed"}]
+        return engine.analyze_modules(mods, baseline=baseline)
+
+    def test_emission_is_byte_stable_across_module_order(self):
+        # the same tree must render byte-identical text/JSON/SARIF no
+        # matter how the walker enumerated files — diffs between two CI
+        # runs must mean the findings changed, not the order did
+        from gan_deeplearning4j_tpu.analysis import sarif
+
+        r1 = self._analyze(["fx/a_mod.py", "fx/b_mod.py"])
+        r2 = self._analyze(["fx/b_mod.py", "fx/a_mod.py"])
+        assert r1.render_text() == r2.render_text()
+        assert json.dumps(r1.to_json()) == json.dumps(r2.to_json())
+        assert (json.dumps(sarif.to_sarif(r1, RULES, []))
+                == json.dumps(sarif.to_sarif(r2, RULES, [])))
+
+    def test_every_partition_is_sorted(self):
+        r = self._analyze(["fx/b_mod.py", "fx/a_mod.py"])
+        key = lambda f: (f.path, f.line, f.code)  # noqa: E731
+        for part in (r.active, r.suppressed, r.baselined):
+            assert [key(f) for f in part] == sorted(key(f) for f in part)
+        assert r.warnings == sorted(r.warnings)
+
+
+class TestProfile:
+    def test_report_carries_phase_and_rule_timings(self):
+        r = run("def f(x):\n    assert x\n")
+        prof = r.profile
+        assert set(prof["phases"]) == {"parse", "index", "rules"}
+        assert all(v >= 0 for v in prof["phases"].values())
+        assert "JG003" in prof["rules"]
+
+    def test_profile_is_not_part_of_the_emitted_report(self):
+        # timings vary run to run; the byte-stable formats must not
+        # include them
+        r = run("def f(x):\n    assert x\n")
+        assert "profile" not in r.to_json()
+        assert "profile" not in r.render_text()
+
+    def test_cli_profile_flag_prints_table_to_stderr(self, tmp_path):
+        p = tmp_path / "dirty.py"
+        p.write_text("def f(x):\n    assert x\n    return x\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "gan_deeplearning4j_tpu.analysis",
+             str(p), "--no-baseline", "--profile"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1
+        assert "--profile (wall seconds)" in proc.stderr
+        assert "phase parse" in proc.stderr
+        assert "JG003" in proc.stderr
+        # stdout is the unchanged report
+        assert "JG003" in proc.stdout and "--profile" not in proc.stdout
+
+
+class TestSuppressionInterplay:
+    def test_unknown_code_in_mixed_disable_still_warns(self):
+        # satellite: disabling a real rule next to a typo'd one must keep
+        # the typo warning — otherwise the typo silently suppresses nothing
+        # and nobody ever learns
+        src = (
+            "import threading\n"
+            "class Sampler:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.counts = {}\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._loop, daemon=True).start()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self.counts['a'] = self.counts.get('a', 0) + 1\n"
+            "        with self._lock:\n"
+            "            self.counts['b'] = 1\n"
+            "    def snapshot(self):\n"
+            "        return dict(self.counts)  # jaxlint: disable=JG024,JG99X\n"
+        )
+        r = run(src)
+        assert codes(r) == []
+        assert len(r.suppressed) == 1
+        assert any("JG99X" in w for w in r.warnings)
+
+
+class TestLintGateScript:
+    def _gate(self, *args, env=None):
+        import shutil
+
+        if shutil.which("bash") is None:  # pragma: no cover
+            pytest.skip("no bash in container")
+        return subprocess.run(
+            ["bash", "scripts/lint_gate.sh", *args],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, **(env or {})},
+        )
+
+    def test_full_gate_fails_on_stale_baseline(self, tmp_path):
+        # satellite: --full is the campaign preflight and the tier-1 shape;
+        # a baseline entry whose bug was fixed must FAIL it, not linger
+        bl = tmp_path / "stale.json"
+        bl.write_text(json.dumps({"entries": [
+            {"fingerprint": "deadbeefdeadbeef", "rule": "JG003",
+             "path": "bench.py", "justification": "fixed long ago"}
+        ]}))
+        proc = self._gate("--full", "--rules", "JG003",
+                          "--baseline", str(bl))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "stale baseline entry" in proc.stdout
+
+    def test_profile_env_passthrough(self):
+        proc = self._gate("--full", "--rules", "JG003",
+                          env={"LINT_PROFILE": "1"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "--profile (wall seconds)" in proc.stderr
