@@ -988,113 +988,116 @@ def parent_main(args) -> None:
     #    host is not a measurement. warn (default) records + proceeds;
     #    require aborts with the refusal in the still-parseable output.
     lock = HostLock(args.lock_file) if args.lock_file else None
-    if args.quiet_host != "off":
-        problems = []
-        if lock is not None:
-            err = lock.acquire()
-            if err:
-                problems.append(err)
-        load = host_load_status(args.max_load)
-        if load is not None:
-            reporter.diag["host_load"] = load
-            if load["busy"]:
-                problems.append(
-                    f"load1 {load['load1']} > max_load {load['max_load']}")
-        if problems:
-            reporter.diag["quiet_host"] = {"mode": args.quiet_host,
-                                           "problems": problems}
-            for msg in problems:
-                print(f"# quiet-host ({args.quiet_host}): {msg}", file=sys.stderr)
-            sys.stderr.flush()
-            if args.quiet_host == "require":
-                for k in keys:
+    # the host lock is paired with the release in the finally:
+    # every exit — the require-mode SystemExit, a mid-run error,
+    # the normal path — gives the lock back exactly once
+    try:
+        if args.quiet_host != "off":
+            problems = []
+            if lock is not None:
+                err = lock.acquire()
+                if err:
+                    problems.append(err)
+            load = host_load_status(args.max_load)
+            if load is not None:
+                reporter.diag["host_load"] = load
+                if load["busy"]:
+                    problems.append(
+                        f"load1 {load['load1']} > max_load {load['max_load']}")
+            if problems:
+                reporter.diag["quiet_host"] = {"mode": args.quiet_host,
+                                               "problems": problems}
+                for msg in problems:
+                    print(f"# quiet-host ({args.quiet_host}): {msg}", file=sys.stderr)
+                sys.stderr.flush()
+                if args.quiet_host == "require":
+                    for k in keys:
+                        reporter.set_result(
+                            k, reporter.stale_entry(k, "host not quiet"))
+                    raise SystemExit(3)
+        # 3) hard wall budget; 8 s reserve so the final flush always lands
+        deadline = t0 + args.budget
+        arm_watchdog(deadline - 8)
+        measure_deadline = deadline - 15
+
+        pending = list(keys)
+        env_pin = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+        if env_pin == "cpu":
+            # deliberate CPU pin: the accelerator phase cannot succeed, skip it
+            reporter.diag["attempts"].append(
+                {"skipped_accelerator": "JAX_PLATFORMS=cpu pinned in env"})
+            accel_done = False
+        else:
+            # bring-up ladder: the child's init IS the probe (ready event). Two
+            # attempts, ~3 min cap total (VERDICT r3: the old ladder burned ~19
+            # min before a byte of output).
+            accel_done = False
+            ladder_deadline = t0 + min(180.0, 0.35 * args.budget)
+            attempt = 0
+            while pending and time.time() < measure_deadline - 30:
+                attempt += 1
+                ready_budget = min(args.probe_timeout * attempt,
+                                   ladder_deadline - time.time())
+                if not accel_done and ready_budget < 15:
+                    break  # ladder exhausted without ever reaching ready
+                per_cfg = 240.0 if "1" in pending else 150.0
+                status, pending = run_child(
+                    pending, "full", False,
+                    ready_budget if not accel_done else 120.0,
+                    per_cfg, reporter, measure_deadline,
+                )
+                if status == "ok":
+                    accel_done = True
+                    break
+                if status == "came_up_cpu":
+                    break  # plugin errored fast, jax fell back — cheap CPU phase
+                if status == "stalled":
+                    # the chip died mid-config (round 3's exact failure): label
+                    # the hung config, keep going with a fresh child — its init
+                    # doubles as the is-it-still-alive re-probe
+                    accel_done = True  # we DID reach the accelerator once
+                    k = pending.pop(0)
+                    e = reporter.stale_entry(k, "stalled on accelerator")
+                    reporter.set_result(k, e)
+                    continue
+                if status in ("no_ready", "child_exit") and accel_done:
+                    break  # accelerator came up once, now gone — fall to CPU
+                # never came up: retry within the ladder, else give up
+                if time.time() >= ladder_deadline - 15:
+                    break
+
+        if pending and time.time() < measure_deadline - 20:
+            # CPU fallback for whatever the accelerator never measured — cheap
+            # variant, axon boot hook stripped (its relay dial hangs when the
+            # chip is down, even under JAX_PLATFORMS=cpu)
+            restarts = 0
+            while pending and time.time() < measure_deadline - 20 and restarts < 4:
+                restarts += 1
+                status, pending = run_child(
+                    pending, "cheap", True, 90.0, 150.0, reporter, measure_deadline,
+                )
+                if status == "ok":
+                    break
+                if status == "stalled" and pending:
+                    # only a config that was actually IN FLIGHT gets blamed; a
+                    # no_ready/child_exit spawn failure just retries the same
+                    # list (bounded by the restarts counter)
+                    k = pending.pop(0)
                     reporter.set_result(
-                        k, reporter.stale_entry(k, "host not quiet"))
-                if lock is not None:
-                    lock.release()
-                raise SystemExit(3)
-    # 3) hard wall budget; 8 s reserve so the final flush always lands
-    deadline = t0 + args.budget
-    arm_watchdog(deadline - 8)
-    measure_deadline = deadline - 15
+                        k, reporter.stale_entry(k, "cpu fallback stalled"))
+        for k in pending:
+            reporter.set_result(k, reporter.stale_entry(
+                k, f"budget: {deadline - time.time():.0f}s left"))
 
-    pending = list(keys)
-    env_pin = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
-    if env_pin == "cpu":
-        # deliberate CPU pin: the accelerator phase cannot succeed, skip it
-        reporter.diag["attempts"].append(
-            {"skipped_accelerator": "JAX_PLATFORMS=cpu pinned in env"})
-        accel_done = False
-    else:
-        # bring-up ladder: the child's init IS the probe (ready event). Two
-        # attempts, ~3 min cap total (VERDICT r3: the old ladder burned ~19
-        # min before a byte of output).
-        accel_done = False
-        ladder_deadline = t0 + min(180.0, 0.35 * args.budget)
-        attempt = 0
-        while pending and time.time() < measure_deadline - 30:
-            attempt += 1
-            ready_budget = min(args.probe_timeout * attempt,
-                               ladder_deadline - time.time())
-            if not accel_done and ready_budget < 15:
-                break  # ladder exhausted without ever reaching ready
-            per_cfg = 240.0 if "1" in pending else 150.0
-            status, pending = run_child(
-                pending, "full", False,
-                ready_budget if not accel_done else 120.0,
-                per_cfg, reporter, measure_deadline,
-            )
-            if status == "ok":
-                accel_done = True
-                break
-            if status == "came_up_cpu":
-                break  # plugin errored fast, jax fell back — cheap CPU phase
-            if status == "stalled":
-                # the chip died mid-config (round 3's exact failure): label
-                # the hung config, keep going with a fresh child — its init
-                # doubles as the is-it-still-alive re-probe
-                accel_done = True  # we DID reach the accelerator once
-                k = pending.pop(0)
-                e = reporter.stale_entry(k, "stalled on accelerator")
-                reporter.set_result(k, e)
-                continue
-            if status in ("no_ready", "child_exit") and accel_done:
-                break  # accelerator came up once, now gone — fall to CPU
-            # never came up: retry within the ladder, else give up
-            if time.time() >= ladder_deadline - 15:
-                break
-
-    if pending and time.time() < measure_deadline - 20:
-        # CPU fallback for whatever the accelerator never measured — cheap
-        # variant, axon boot hook stripped (its relay dial hangs when the
-        # chip is down, even under JAX_PLATFORMS=cpu)
-        restarts = 0
-        while pending and time.time() < measure_deadline - 20 and restarts < 4:
-            restarts += 1
-            status, pending = run_child(
-                pending, "cheap", True, 90.0, 150.0, reporter, measure_deadline,
-            )
-            if status == "ok":
-                break
-            if status == "stalled" and pending:
-                # only a config that was actually IN FLIGHT gets blamed; a
-                # no_ready/child_exit spawn failure just retries the same
-                # list (bounded by the restarts counter)
-                k = pending.pop(0)
-                reporter.set_result(
-                    k, reporter.stale_entry(k, "cpu fallback stalled"))
-    for k in pending:
-        reporter.set_result(k, reporter.stale_entry(
-            k, f"budget: {deadline - time.time():.0f}s left"))
-
-    if args.update_baselines:
-        merged = merge_baselines(baselines, reporter.results.values())
-        if merged != baselines:
-            with open(BASELINES_FILE, "w") as fh:
-                json.dump(merged, fh, indent=2)
-            print(f"# baselines updated: {BASELINES_FILE}", file=sys.stderr)
-    if lock is not None:
-        lock.release()
+        if args.update_baselines:
+            merged = merge_baselines(baselines, reporter.results.values())
+            if merged != baselines:
+                with open(BASELINES_FILE, "w") as fh:
+                    json.dump(merged, fh, indent=2)
+                print(f"# baselines updated: {BASELINES_FILE}", file=sys.stderr)
+    finally:
+        if lock is not None:
+            lock.release()
     reporter.emit()
     if any("error" in r for r in reporter.results.values()):
         raise SystemExit(1)

@@ -10,9 +10,15 @@ the same shape compiler diagnostics use, so editors annotate it for free.
 
 Modes beyond plain analysis:
 
-- ``--changed-only [--diff-base REF]`` restricts the run to .py files
+- ``--changed-only [--diff-base REF]`` runs rules only on .py files
   changed vs the merge base with REF (plus untracked files) — the fast
-  pre-commit shape ``scripts/lint_gate.sh`` wraps;
+  pre-commit shape ``scripts/lint_gate.sh`` wraps. The full target set
+  is still parsed and indexed (cross-module rules need real context);
+  with ``--cache-dir`` that parse is warm, so the run costs roughly
+  rules-on-the-diff;
+- ``--cache-dir DIR`` persists parsed modules keyed by content hash
+  (``LINT_CACHE=off`` is the escape hatch; hit/miss counts under
+  ``--profile``);
 - ``--fix`` applies the mechanical rewrites (JG003 asserts, JG007
   discarded updates) and re-reports what remains; ``--fix-suppress``
   instead inserts per-line suppressions for every remaining active
@@ -30,6 +36,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from gan_deeplearning4j_tpu.analysis import engine
@@ -48,6 +55,10 @@ def _render_profile(report, rules) -> str:
     for key in ("parse", "index", "rules"):
         if key in phases:
             lines.append(f"#   phase {key:<8s} {phases[key]:8.3f}s")
+    cache = prof.get("cache")
+    if cache is not None:
+        lines.append(f"#   cache hits {cache.get('hits', 0)} / "
+                     f"misses {cache.get('misses', 0)}")
     per_rule = prof.get("rules", {})
     for code in sorted(per_rule, key=lambda c: (-per_rule[c], c)):
         lines.append(f"#   {code} {names.get(code, '?'):<34s} "
@@ -106,6 +117,15 @@ def main(argv=None) -> int:
     p.add_argument("--profile", action="store_true",
                    help="print a per-phase/per-rule wall-time table to "
                         "stderr (the report itself is unchanged)")
+    p.add_argument("--cache-dir", default=os.environ.get("JAXLINT_CACHE_DIR"),
+                   help="persist parsed modules here keyed by content hash "
+                        "so repeat runs skip the parse phase for unchanged "
+                        "files (default: $JAXLINT_CACHE_DIR, else no cache; "
+                        "LINT_CACHE=off disables even an explicit dir)")
+    p.add_argument("--lifecycle-stats", default=None, metavar="FILE",
+                   help="write lifecycle-index stats (pairs discovered, "
+                        "opens, transfers resolved) as JSON to FILE — the "
+                        "campaign preflight snapshot")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -138,6 +158,10 @@ def main(argv=None) -> int:
         print(f"jaxlint: {exc}", file=sys.stderr)
         return 2
 
+    # --changed-only still PARSES every target (phase 1 indexes the full
+    # tree, so cross-module rules see real context — the cache makes that
+    # cheap) but runs rules only on the changed files
+    check_paths = None
     if args.changed_only:
         try:
             changed = set(engine.changed_files(base=args.diff_base))
@@ -145,16 +169,47 @@ def main(argv=None) -> int:
             print(f"jaxlint: --changed-only needs a usable git checkout: "
                   f"{exc}", file=sys.stderr)
             return 2
-        targets = [t for t in targets if t in changed]
-        if not targets:
+        check_paths = {t for t in targets if t in changed}
+        if not check_paths:
             print("# jaxlint: no changed .py files under the given paths",
                   file=sys.stderr)
             return 0
 
+    cache = None
+    if args.cache_dir and os.environ.get("LINT_CACHE", "").lower() != "off":
+        try:
+            cache = engine.ParseCache(args.cache_dir)
+        except OSError as exc:
+            print(f"jaxlint: cache disabled ({exc})", file=sys.stderr)
+
     def run():
-        return engine.analyze_paths(targets, rules=rules, baseline=baseline)
+        return engine.analyze_paths(targets, rules=rules, baseline=baseline,
+                                    cache=cache, check_paths=check_paths)
 
     report = run()
+
+    if report.baseline_migrations and not args.no_baseline:
+        # entries matched under the legacy fingerprint scheme: rewrite
+        # them in place so the next run matches directly
+        entries = engine.load_baseline(args.baseline)
+        moved = 0
+        for e in entries:
+            new_fp = report.baseline_migrations.get(e.get("fingerprint"))
+            if new_fp is not None:
+                e["fingerprint"] = new_fp
+                moved += 1
+        if moved:
+            engine.write_baseline(entries, args.baseline)
+            print(f"jaxlint: migrated {moved} baseline "
+                  f"entr{'y' if moved == 1 else 'ies'} to context-aware "
+                  f"fingerprints in {args.baseline}", file=sys.stderr)
+            baseline = engine.load_baseline(args.baseline)
+
+    if args.lifecycle_stats and report.index is not None:
+        with open(args.lifecycle_stats, "w") as fh:
+            json.dump(report.index.lifecycle.stats(), fh, indent=2,
+                      sort_keys=True)
+            fh.write("\n")
 
     if args.write_baseline:
         entries = [

@@ -33,15 +33,25 @@ the locks held around it. A call-site propagation pass marks private
 helpers whose every in-class call site holds lock L as guarded-by-L, so
 the ``_flush_locked``-style convention does not read as an escape.
 
+Lock identities **unify across classes** where the sharing is statically
+visible: a lock passed through a constructor (``Worker(lock=self._lock)``
+where ``Worker.__init__`` does ``self._lk = lock``) and a lock planted by
+attribute assignment (``worker._lk = self._lock`` on an object whose
+class resolves) collapse into one canonical id in a project-wide
+union-find, so JG025's acquisition graph spans planes instead of
+stopping at the class boundary — the false-negative class the first
+concurrency PR documented.
+
 Everything is statically visible facts only. Known false-negative classes
 (documented here once, referenced by the rules): ``.acquire()``/
-``.release()`` pairs outside ``with`` are not tracked; module-global state
-shared by module-level thread targets is not modeled (only classes are);
-locks reached through cross-class attribute chains (``self.registry.lock``
-vs the registry's own ``self.lock``) do not unify, so cross-plane
-inversions need the dynamic drills; nested ``def``/``lambda`` bodies are
-separate scopes (a closure may run on another thread after the ``with``
-exited — the same rule JG022 applies).
+``.release()`` pairs outside ``with`` are not tracked (the *lifecycle*
+index owns that pairing — JG027/JG028); module-global state shared by
+module-level thread targets is not modeled (only classes are); locks
+reached through cross-class attribute chains (``self.registry.lock`` vs
+the registry's own ``self.lock``) unify only via the constructor/
+assignment routes above, not by chained attribute typing; nested
+``def``/``lambda`` bodies are separate scopes (a closure may run on
+another thread after the ``with`` exited — the same rule JG022 applies).
 """
 
 from __future__ import annotations
@@ -171,6 +181,16 @@ class ClassConcurrency:
     #: False for BaseHTTPRequestHandler subclasses: instances are
     #: per-request, so ``self.<attr>`` is NOT cross-thread shared state
     instance_shared: bool = True
+    #: ``__init__`` positional parameter names (self excluded), for
+    #: matching constructor-injection call sites positionally
+    init_params: List[str] = dataclasses.field(default_factory=list)
+    #: ``__init__`` param name -> ``self`` attr it is forwarded into
+    #: (``self._lk = lock``) — the receiving half of lock injection
+    init_param_attrs: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+    #: ``self`` attr -> resolved constructor dotted name (``self.worker =
+    #: Worker(...)``), for typing ``self.worker._lk = ...`` assignments
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def canonical_lock(self, attr: str) -> str:
         seen = set()
@@ -237,6 +257,13 @@ class ConcurrencyIndex:
     def __init__(self, project) -> None:
         self._project = project
         self._cache: Dict[str, List[ClassConcurrency]] = {}
+        self._lock_parent: Optional[dict] = None  # union-find forest
+        self._global_edges: Optional[dict] = None
+        #: (path, class name) -> attrs taught to be locks by cross-class
+        #: plants (``worker._lk = self._lock`` where ``_lk`` is never
+        #: constructed locally)
+        self._extra_locks: Dict[tuple, Set[str]] = {}
+        self._new_extras: Dict[tuple, Set[str]] = {}
 
     def classes(self, path: str) -> List[ClassConcurrency]:
         """Summaries for every class in ``path`` (nested classes included)
@@ -244,9 +271,199 @@ class ConcurrencyIndex:
         functions (for lock-order analysis over module-global locks)."""
         if path not in self._cache:
             info = self._project.by_path.get(path)
+            extras = {cls: attrs for (p, cls), attrs
+                      in self._extra_locks.items() if p == path}
             self._cache[path] = (
-                [] if info is None else _build_module(info.srcmod))
+                [] if info is None
+                else _build_module(info.srcmod, extras))
         return self._cache[path]
+
+    # -- cross-class lock unification ---------------------------------------
+    # Lock ids are per-module pairs ``(module_name, short_id)`` so two
+    # unrelated classes that happen to share a name never collide; the
+    # union-find collapses pairs that provably alias ONE runtime lock:
+    # constructor injection (``Worker(lock=self._lock)`` forwarded into
+    # ``self._lk``) and attribute planting (``worker._lk = self._lock``
+    # on an object whose class resolves through the project index).
+
+    def _all(self) -> List[tuple]:
+        out = []
+        for path in sorted(self._project.by_path):
+            info = self._project.by_path[path]
+            for cc in self.classes(path):
+                out.append((info, cc))
+        return out
+
+    def _find(self, key: tuple) -> tuple:
+        p = self._lock_parent
+        while p.get(key, key) != key:
+            p[key] = p.get(p[key], p[key])  # path halving
+            key = p[key]
+        return key
+
+    def _union(self, a: tuple, b: tuple) -> None:
+        ra, rb = self._find(a), self._find(b)
+        if ra == rb:
+            return
+        # deterministic root: lexicographically smallest key wins, so
+        # canonical ids are stable across module orderings
+        root, child = (ra, rb) if ra <= rb else (rb, ra)
+        self._lock_parent[child] = root
+
+    def canonical(self, module_name: str, lock_id: str) -> tuple:
+        """Project-wide canonical identity of a per-module lock id."""
+        self._ensure_unified()
+        return self._find((module_name, lock_id))
+
+    def _ensure_unified(self) -> None:
+        if self._lock_parent is not None:
+            return
+        # a plant can TEACH a class that an attr it never constructs is
+        # a lock (``worker._lk = self._lock`` into ``self._lk = None``) —
+        # its summary must be rebuilt so ``with self._lk:`` registers as
+        # an acquisition, then the scan repeats; bounded because plants
+        # of planted locks are rare and each round only adds attrs
+        for _ in range(4):
+            self._lock_parent = {}
+            self._new_extras = {}
+            everything = self._all()
+            class_map: Dict[str, tuple] = {}
+            for info, cc in everything:
+                if cc.node is not None:
+                    class_map[f"{info.name}.{cc.name}"] = (info, cc)
+            for info, cc in everything:
+                encl = cc if cc.node is not None else None
+                for name in sorted(cc.methods):
+                    self._scan_sharing(info, encl, cc.methods[name].node,
+                                       class_map)
+            fresh = {k: v - self._extra_locks.get(k, set())
+                     for k, v in self._new_extras.items()}
+            fresh = {k: v for k, v in fresh.items() if v}
+            if not fresh:
+                break
+            for key, attrs in fresh.items():
+                self._extra_locks.setdefault(key, set()).update(attrs)
+                self._cache.pop(key[0], None)
+
+    def _resolve_class(self, info, func_expr: ast.AST,
+                       class_map: dict) -> Optional[tuple]:
+        resolved = info.srcmod.resolve(func_expr)
+        if resolved is None:
+            return None
+        canon = self._project._canonical_call(info, resolved)
+        return class_map.get(canon)
+
+    def _lock_expr_id(self, info, encl: Optional[ClassConcurrency],
+                      expr: ast.AST) -> Optional[tuple]:
+        """(module, short_id) when ``expr`` denotes a known lock in the
+        enclosing scope, else None."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if encl is not None and (attr in encl.lock_attrs
+                                     or _is_lockish_name(attr)):
+                return (info.name, encl.lock_id(attr))
+            return None
+        if isinstance(expr, ast.Name) and _is_lockish_name(expr.id):
+            return (info.name, expr.id)
+        return None
+
+    def _scan_sharing(self, info, encl, fn, class_map: dict) -> None:
+        # local var -> (info, cc) of its constructed class, in source
+        # order (good enough: sharing sites follow their constructions)
+        local_types: Dict[str, tuple] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(node.value, ast.Call) and isinstance(
+                        tgt, ast.Name):
+                    owner = self._resolve_class(info, node.value.func,
+                                                class_map)
+                    if owner is not None:
+                        local_types[tgt.id] = owner
+                if isinstance(tgt, ast.Attribute):
+                    lock = self._lock_expr_id(info, encl, node.value)
+                    if lock is not None:
+                        owner = self._owner_of(tgt.value, local_types,
+                                               info, encl, class_map)
+                        if owner is not None:
+                            oinfo, occ = owner
+                            self._union(lock, (oinfo.name,
+                                               occ.lock_id(tgt.attr)))
+                            if tgt.attr not in occ.lock_attrs:
+                                self._new_extras.setdefault(
+                                    (oinfo.path, occ.name),
+                                    set()).add(tgt.attr)
+            if isinstance(node, ast.Call):
+                target = self._resolve_class(info, node.func, class_map)
+                if target is None:
+                    continue
+                tinfo, tcc = target
+                for i, arg in enumerate(node.args):
+                    if i < len(tcc.init_params):
+                        self._unify_arg(info, encl, arg, tinfo, tcc,
+                                        tcc.init_params[i])
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        self._unify_arg(info, encl, kw.value, tinfo, tcc,
+                                        kw.arg)
+
+    def _unify_arg(self, info, encl, arg, tinfo, tcc, param: str) -> None:
+        lock = self._lock_expr_id(info, encl, arg)
+        attr = tcc.init_param_attrs.get(param)
+        if lock is None or attr is None:
+            return
+        self._union(lock, (tinfo.name, tcc.lock_id(attr)))
+
+    def _owner_of(self, expr, local_types, info, encl,
+                  class_map) -> Optional[tuple]:
+        """(info, cc) of the class of ``expr`` (a receiver being planted
+        with a lock): a local constructed in this function, or a ``self``
+        attr the enclosing class constructed."""
+        if isinstance(expr, ast.Name):
+            return local_types.get(expr.id)
+        attr = _self_attr(expr)
+        if attr is not None and encl is not None:
+            ctor = encl.attr_types.get(attr)
+            if ctor is not None:
+                canon = self._project._canonical_call(info, ctor)
+                return class_map.get(canon)
+        return None
+
+    def global_lock_edges(self) -> dict:
+        """The project-wide acquisition graph over canonical lock ids:
+        ``(A, B) -> (path, node, where)`` of the first site that takes B
+        while holding A (lexical nesting plus the one-hop same-class call
+        lens). Deterministic: modules in sorted-path order, methods
+        sorted, so "first" is stable across runs."""
+        self._ensure_unified()
+        if self._global_edges is not None:
+            return self._global_edges
+        edges: dict = {}
+
+        def add(mname, path, held, lock, node, where):
+            lk = self._find((mname, lock))
+            for h in held:
+                hh = self._find((mname, h))
+                if hh != lk and (hh, lk) not in edges:
+                    edges[(hh, lk)] = (path, node, where)
+
+        for info, cc in self._all():
+            for name in sorted(cc.methods):
+                mc = cc.methods[name]
+                for acq in mc.acquisitions:
+                    add(info.name, info.path, acq.held_before, acq.lock,
+                        acq.node, f"{cc.name}.{name}")
+                for call in mc.self_calls:
+                    if not call.held:
+                        continue
+                    callee = cc.methods.get(call.callee)
+                    if callee is None:
+                        continue
+                    for acq in callee.acquisitions:
+                        add(info.name, info.path, call.held, acq.lock,
+                            call.node, f"{cc.name}.{name} -> {call.callee}")
+        self._global_edges = edges
+        return edges
 
 
 def build(project) -> ConcurrencyIndex:
@@ -255,12 +472,13 @@ def build(project) -> ConcurrencyIndex:
 
 # -- construction -----------------------------------------------------------
 
-def _build_module(mod) -> List[ClassConcurrency]:
+def _build_module(mod, extra_locks=None) -> List[ClassConcurrency]:
     out: List[ClassConcurrency] = []
     class_nodes: List[ast.ClassDef] = [
         n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)]
     for cls in class_nodes:
-        out.append(_build_class(mod, cls))
+        out.append(_build_class(
+            mod, cls, (extra_locks or {}).get(cls.name, set())))
     # module-scope pseudo-class: top-level functions + module locks, so
     # JG025 sees ``with _capture_lock:`` nesting outside any class
     scope = ClassConcurrency(name="<module>", path=mod.path, node=None)
@@ -272,8 +490,11 @@ def _build_module(mod) -> List[ClassConcurrency]:
     return out
 
 
-def _build_class(mod, cls: ast.ClassDef) -> ClassConcurrency:
+def _build_class(mod, cls: ast.ClassDef,
+                 extra_locks=frozenset()) -> ClassConcurrency:
     cc = ClassConcurrency(name=cls.name, path=mod.path, node=cls)
+    # attrs taught to be locks by cross-class plants (unification pass)
+    cc.lock_attrs.update(extra_locks)
     methods = [n for n in cls.body
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
     method_names = {m.name for m in methods}
@@ -320,6 +541,35 @@ def _build_class(mod, cls: ast.ClassDef) -> ClassConcurrency:
             if other is not None and (other in cc.lock_attrs
                                       or _is_lockish_name(other)):
                 cc.lock_aliases[attr] = other
+
+    # __init__ signature + param->attr forwarding and attr constructor
+    # types — the raw material of cross-class lock unification
+    for m in methods:
+        if m.name == "__init__":
+            names = [a.arg for a in m.args.posonlyargs + m.args.args]
+            if names and names[0] in ("self", "cls"):
+                names = names[1:]
+            cc.init_params = names
+            valid = set(names) | {a.arg for a in m.args.kwonlyargs}
+            for node in ast.walk(m):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in valid):
+                    attr = _self_attr(node.targets[0])
+                    if attr is not None:
+                        cc.init_param_attrs[node.value.id] = attr
+                        # a lockish PARAM forwarded into any attr makes
+                        # that attr a lock (``self._lk = lock``) — the
+                        # receiving half of constructor injection
+                        if _is_lockish_name(node.value.id):
+                            cc.lock_attrs.add(attr)
+        for node in ast.walk(m):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                attr = _self_attr(node.targets[0])
+                ctor = mod.resolve(node.value.func)
+                if attr is not None and ctor is not None:
+                    cc.attr_types.setdefault(attr, ctor)
 
     # spawned-thread entry points: Thread(target=self.m) / Timer(dt, self.m)
     for m in methods:

@@ -1,11 +1,23 @@
 """jaxlint engine: file walking, suppression comments, baseline, reporting.
 
-Fingerprints are content-based — ``sha1(rule|path|normalized source line)``
-— so a baseline entry survives unrelated edits that shift line numbers, and
-goes stale (reported as such) the moment the offending line itself changes.
-Every baseline entry must carry a human ``justification``; the engine
-refuses entries without one, so "baseline it" can never silently become
-"ignore it".
+Fingerprints are content-based — ``sha1(rule|path|normalized source line|
+neighbor-context hash)`` — so a baseline entry survives unrelated edits
+that shift line numbers, and goes stale (reported as such) the moment the
+offending line itself (or its immediate neighborhood) changes. The
+neighbor-context component disambiguates two textually identical lines in
+one file; entries written under the older line-only scheme still match
+(legacy fallback) and are auto-migrated to the current scheme by the CLI
+on first run. Every baseline entry must carry a human ``justification``;
+the engine refuses entries without one, so "baseline it" can never
+silently become "ignore it".
+
+The incremental cache (:class:`ParseCache`) persists parsed modules —
+AST, suppression table, import map — keyed by per-file content hash, so
+repeat runs (and ``--changed-only`` runs, which parse the FULL target set
+for project-index fidelity but run rules only on the changed files) skip
+the parse phase for unchanged files. Rules always re-run: findings are
+cross-module facts and caching them per-file would be wrong the moment an
+edit in one file changes what a rule reports about another.
 """
 
 from __future__ import annotations
@@ -16,6 +28,7 @@ import hashlib
 import io
 import json
 import os
+import pickle
 import re
 import time
 import tokenize
@@ -24,6 +37,10 @@ from typing import Iterable, List, Optional
 from gan_deeplearning4j_tpu.analysis import _common
 
 DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__), "_baseline.json")
+
+#: bump to invalidate every ParseCache entry (pickle layout, SourceModule
+#: fields, suppression scanning — anything that changes parsed artifacts)
+CACHE_VERSION = 1
 
 # directories never worth descending into
 _SKIP_DIRS = {".git", "__pycache__", ".jax_cache", "artifacts", ".pytest_cache",
@@ -44,9 +61,24 @@ class Finding:
     line: int
     col: int
     snippet: str
+    #: hash of the nearest non-blank neighbor lines (above + below),
+    #: normalized — disambiguates identical offending lines in one file
+    #: without re-introducing raw line numbers. "" for findings built
+    #: outside a SourceModule (parse failures, direct construction).
+    context: str = ""
 
     @property
     def fingerprint(self) -> str:
+        norm = " ".join(self.snippet.split())
+        digest = hashlib.sha1(
+            f"{self.code}|{self.path}|{norm}|{self.context}".encode()
+        ).hexdigest()
+        return digest[:16]
+
+    @property
+    def legacy_fingerprint(self) -> str:
+        """The pre-context scheme — matched as a fallback so baselines
+        written before the migration keep working, then rewritten."""
         norm = " ".join(self.snippet.split())
         digest = hashlib.sha1(
             f"{self.code}|{self.path}|{norm}".encode()
@@ -91,7 +123,23 @@ class SourceModule:
             line=lineno,
             col=col,
             snippet=self.line_text(lineno).strip(),
+            context=self._neighbor_context(lineno),
         )
+
+    def _neighbor_context(self, lineno: int) -> str:
+        """Short hash of the nearest non-blank line above and below
+        ``lineno`` (whitespace-normalized). Blank lines are skipped so a
+        spacing-only edit does not stale a fingerprint; edits to the
+        actual surrounding code do."""
+        def nearest(rng) -> str:
+            for ln in rng:
+                text = " ".join(self.line_text(ln).split())
+                if text:
+                    return text
+            return ""
+        above = nearest(range(lineno - 1, 0, -1))
+        below = nearest(range(lineno + 1, len(self.lines) + 1))
+        return hashlib.sha1(f"{above}\n{below}".encode()).hexdigest()[:8]
 
     def suppressed(self, finding: Finding, node: ast.AST = None) -> bool:
         """A ``# jaxlint: disable=JG00x`` on the finding's line — or, when
@@ -118,11 +166,20 @@ class Report:
     stale_baseline: List[dict]  # baseline entries that matched nothing
     files: int
     warnings: List[str] = dataclasses.field(default_factory=list)
-    # wall-time breakdown: {"phases": {...}, "rules": {code: seconds}}.
+    # wall-time breakdown: {"phases": {...}, "rules": {code: seconds},
+    # "cache": {"hits": .., "misses": ..} when a ParseCache was used}.
     # Deliberately NOT part of to_json()/render_text() — timings vary run
     # to run and every emission format must be byte-stable for identical
     # inputs. The CLI renders it separately under --profile.
     profile: Optional[dict] = None
+    #: legacy fingerprint -> current fingerprint, for baseline entries
+    #: that matched only under the pre-context scheme; the CLI rewrites
+    #: the baseline file from this map (auto-migration). Not part of
+    #: to_json() — it describes the baseline FILE, not the tree.
+    baseline_migrations: dict = dataclasses.field(default_factory=dict)
+    #: the run's ProjectIndex (transient — CLI-side consumers like
+    #: ``--lifecycle-stats`` read it; never serialized)
+    index: Optional[object] = None
 
     @property
     def clean(self) -> bool:
@@ -383,12 +440,19 @@ def _run_rules(mod: SourceModule, rules,
     return out
 
 
-def analyze_modules(mods, rules=None, baseline=None) -> Report:
+def analyze_modules(mods, rules=None, baseline=None,
+                    check_paths=None, cache_stats=None) -> Report:
     """Two-phase analysis: materialize every module, build the project
     index (phase 1), then run the rules (phase 2). Cross-module rules may
     attribute a finding to a DIFFERENT file than the one being iterated
     (e.g. a scan body defined a module away) — suppression is therefore
-    checked against the module that owns the finding's path."""
+    checked against the module that owns the finding's path.
+
+    ``check_paths`` (a set, or None for all) restricts phase 2 to those
+    modules while phase 1 still indexes everything — the ``--changed-only``
+    shape: full cross-module context, rules paid only for the changed
+    files. ``cache_stats`` is a ``{"hits": .., "misses": ..}`` dict from a
+    :class:`ParseCache`, surfaced in the profile."""
     from gan_deeplearning4j_tpu.analysis import project as _project
     from gan_deeplearning4j_tpu.analysis.rules import RULES, RULES_BY_CODE
 
@@ -396,6 +460,7 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
     baseline = baseline or []
     by_fp = {e["fingerprint"]: e for e in baseline}
     matched_fps = set()
+    migrations: dict = {}
     active, suppressed, baselined = [], [], []
     warnings: List[str] = []
     seen = set()  # scope overlap can surface one defect twice — keep first
@@ -410,8 +475,13 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
     for m in parsed:
         m.project = index
         mod_by_path[m.path] = m
+    checked = [m for m in mods
+               if check_paths is None
+               or getattr(m, "path", None) in check_paths]
     known_codes = set(RULES_BY_CODE) | {"all", "JG000"}
-    for m in parsed:
+    for m in checked:
+        if not isinstance(m, SourceModule):
+            continue
         for line, codes in sorted(m.suppressions.items()):
             for code in sorted(codes - known_codes):
                 warnings.append(
@@ -421,7 +491,7 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
     files = 0
     rule_times: dict = {}
     t0 = time.perf_counter()
-    for mod in mods:
+    for mod in checked:
         files += 1
         if isinstance(mod, Finding):  # parse failure
             active.append(mod)
@@ -437,15 +507,22 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
             elif finding.fingerprint in by_fp:
                 matched_fps.add(finding.fingerprint)
                 baselined.append(finding)
+            elif finding.legacy_fingerprint in by_fp:
+                # pre-context-scheme entry: still honored, and recorded
+                # for auto-migration to the current fingerprint
+                matched_fps.add(finding.legacy_fingerprint)
+                migrations[finding.legacy_fingerprint] = finding.fingerprint
+                baselined.append(finding)
             else:
                 active.append(finding)
     # Staleness is judged ONLY within this run's scope: an entry whose path
-    # was not analyzed or whose rule did not run might still match on the
-    # next full run — calling it stale here would fail every scoped run
-    # (--changed-only, path subsets, --rules) and let --prune-baseline
-    # delete still-valid entries. Entries without path/rule metadata are
-    # conservatively treated as in-scope.
-    analyzed = {m.path for m in mods if hasattr(m, "path")}
+    # was not analyzed (or not rule-checked — --changed-only indexes the
+    # full tree but checks a subset) or whose rule did not run might still
+    # match on the next full run — calling it stale here would fail every
+    # scoped run (--changed-only, path subsets, --rules) and let
+    # --prune-baseline delete still-valid entries. Entries without
+    # path/rule metadata are conservatively treated as in-scope.
+    analyzed = {m.path for m in checked if hasattr(m, "path")}
     rule_codes = {r.code for r in rules}
     stale = [
         e for e in baseline
@@ -468,13 +545,78 @@ def analyze_modules(mods, rules=None, baseline=None) -> Report:
         "phases": {"parse": t_parse, "index": t_index, "rules": t_rules},
         "rules": rule_times,
     }
+    if cache_stats is not None:
+        profile["cache"] = dict(cache_stats)
     return Report(active, suppressed, baselined, stale, files,
-                  warnings=warnings, profile=profile)
+                  warnings=warnings, profile=profile,
+                  baseline_migrations=migrations, index=index)
 
 
-def analyze_paths(paths, rules=None, baseline=None, root=None) -> Report:
+class ParseCache:
+    """Per-file persistence of parsed modules, keyed by content hash.
+
+    One pickle per file under ``dirpath``, named by
+    ``sha256(version|relpath|content)`` — an edited file simply misses
+    (its old entry is overwritten on store, so the directory does not
+    grow per edit), and any unpicklable/corrupt entry degrades to a miss.
+    Only the parse phase is cached; rules always re-run (findings are
+    cross-module facts). ``stats`` feeds the ``--profile`` table."""
+
+    def __init__(self, dirpath: str) -> None:
+        self.dir = dirpath
+        self.stats = {"hits": 0, "misses": 0}
+        os.makedirs(dirpath, exist_ok=True)
+
+    def _key(self, relpath: str, text: str) -> str:
+        norm = relpath.replace(os.sep, "/")
+        return hashlib.sha256(
+            f"{CACHE_VERSION}|{norm}\0{text}".encode()
+        ).hexdigest()
+
+    def _entry(self, relpath: str) -> str:
+        # stable per-PATH filename (content hash verified inside): an
+        # edit REPLACES the file's entry instead of accreting stale blobs
+        name = hashlib.sha256(
+            relpath.replace(os.sep, "/").encode()).hexdigest()
+        return os.path.join(self.dir, f"{name}.pkl")
+
+    def load(self, relpath: str, text: str):
+        """Cached parse_module() result (SourceModule or Finding), or
+        None on miss."""
+        try:
+            with open(self._entry(relpath), "rb") as fh:
+                key, obj = pickle.load(fh)
+        except Exception:
+            self.stats["misses"] += 1
+            return None
+        if key != self._key(relpath, text):
+            self.stats["misses"] += 1
+            return None
+        self.stats["hits"] += 1
+        return obj
+
+    def store(self, relpath: str, text: str, obj) -> None:
+        """Best-effort write (a read-only cache dir must not fail the
+        lint run); ``obj.project`` is never persisted."""
+        if isinstance(obj, SourceModule):
+            obj = dataclasses.replace(obj, project=None)
+        try:
+            tmp = self._entry(relpath) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as fh:
+                pickle.dump((self._key(relpath, text), obj), fh,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._entry(relpath))
+        except Exception:
+            pass
+
+
+def analyze_paths(paths, rules=None, baseline=None, root=None,
+                  cache: Optional[ParseCache] = None,
+                  check_paths=None) -> Report:
     """Analyze files/directories. ``baseline`` is a loaded entry list (use
-    :func:`load_baseline`), or None for no baseline."""
+    :func:`load_baseline`), or None for no baseline. ``cache`` short-cuts
+    the parse phase for unchanged files; ``check_paths`` restricts the
+    rule phase (phase 1 still indexes every collected file)."""
     root = os.path.abspath(root or os.getcwd())
 
     def gen():
@@ -486,9 +628,19 @@ def analyze_paths(paths, rules=None, baseline=None, root=None) -> Report:
             except OSError as exc:
                 yield Finding("JG000", f"unreadable: {exc}", rp, 1, 0, "")
                 continue
-            yield parse_module(text, rp)
+            if cache is not None:
+                hit = cache.load(rp, text)
+                if hit is not None:
+                    yield hit
+                    continue
+            mod = parse_module(text, rp)
+            if cache is not None:
+                cache.store(rp, text, mod)
+            yield mod
 
-    return analyze_modules(gen(), rules=rules, baseline=baseline)
+    return analyze_modules(
+        gen(), rules=rules, baseline=baseline, check_paths=check_paths,
+        cache_stats=None if cache is None else cache.stats)
 
 
 def analyze_source(text: str, path: str = "<string>", rules=None,

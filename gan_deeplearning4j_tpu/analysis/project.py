@@ -174,6 +174,7 @@ class ProjectIndex:
         self._io_taint_cache: Dict[str, bool] = {}
         self._spawn_taint_cache: Dict[str, bool] = {}
         self._concurrency = None
+        self._lifecycle = None
         for mod in srcmods:
             self._index_module(mod)
         # second pass: module-level donators that need every summary in place
@@ -190,6 +191,17 @@ class ProjectIndex:
 
             self._concurrency = _conc.build(self)
         return self._concurrency
+
+    @property
+    def lifecycle(self):
+        """The paired-resource extension (:mod:`.lifecycle`), built lazily
+        on first use so runs that exclude JG027–JG029 pay nothing for it;
+        per-path summaries are cached inside the returned index."""
+        if self._lifecycle is None:
+            from gan_deeplearning4j_tpu.analysis import lifecycle as _life
+
+            self._lifecycle = _life.build(self)
+        return self._lifecycle
 
     # -- construction -------------------------------------------------------
     def _index_module(self, mod) -> None:

@@ -62,6 +62,15 @@ from gan_deeplearning4j_tpu.analysis.rules.lock_order import (
 from gan_deeplearning4j_tpu.analysis.rules.lock_blocking import (
     BlockingCallUnderLock,
 )
+from gan_deeplearning4j_tpu.analysis.rules.resource_leak import (
+    LeakedPairedResource,
+)
+from gan_deeplearning4j_tpu.analysis.rules.release_balance import (
+    UnbalancedRelease,
+)
+from gan_deeplearning4j_tpu.analysis.rules.handoff import (
+    HandoffWithoutTransfer,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -90,6 +99,9 @@ RULES = [
     UnguardedSharedMutableState(),
     LockOrderInversion(),
     BlockingCallUnderLock(),
+    LeakedPairedResource(),
+    UnbalancedRelease(),
+    HandoffWithoutTransfer(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

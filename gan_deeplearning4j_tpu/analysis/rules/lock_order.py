@@ -9,29 +9,39 @@ few instructions wide. The classic static check: build the
 lock-acquisition graph (edge A→B when B is acquired while A is held) and
 flag cycles.
 
-The model (phase-1 concurrency index): per module, every ``with <lock>:``
-acquisition contributes edges from each lock already held (lexical
-nesting), plus one resolved same-class call hop — ``with self._a:
-self._helper()`` where ``_helper`` does ``with self._b:`` contributes
-A→B at the call site. Lock identities are class-qualified for ``self``
-locks (``Manager._lock``), source text for module-level and foreign locks
-(``_capture_lock``, ``registry.lock``); condition variables constructed
-over a lock alias to that lock. A cycle in the per-module graph is
-reported once, at the edge that closes it, naming the full cycle and
-where each edge was taken.
+The model (phase-1 concurrency index): every ``with <lock>:`` acquisition
+contributes edges from each lock already held (lexical nesting), plus one
+resolved same-class call hop — ``with self._a: self._helper()`` where
+``_helper`` does ``with self._b:`` contributes A→B at the call site. Lock
+identities are class-qualified for ``self`` locks (``Manager._lock``),
+source text for module-level and foreign locks (``_capture_lock``,
+``registry.lock``) — and **unified across classes** through the index's
+project-wide union-find: a lock injected via a constructor
+(``Worker(lock=self._lock)`` forwarded into ``self._lk``) or planted by
+attribute assignment (``worker._lk = self._lock``) is ONE canonical lock,
+and the acquisition graph is project-wide, so an inversion split between
+two planes (manager nests A→B, the worker it built around the same A
+nests B→A) is found even though neither module alone contains a cycle.
+Each cycle is reported exactly once, in the module owning its closing
+edge (first in sorted path/line order — deterministic across runs).
 
-Not flagged: re-acquiring the same canonical lock (RLock re-entrancy and
-Condition-over-lock aliasing are not inversions); consistent global
-orderings (A→B twice is one edge); acquisition sequences in different
-modules (documented false negative: cross-plane inversions need lock ids
-that unify across classes, which static ``self`` analysis cannot give —
-the drills own that). ``.acquire()``/``.release()`` outside ``with`` is
-likewise invisible.
+Not flagged: re-acquiring the same canonical lock (RLock re-entrancy,
+Condition-over-lock aliasing, and a shared injected lock held on both
+sides of a call are not inversions); consistent global orderings (A→B
+twice is one edge). Known false negatives: sharing routes other than
+constructor injection/attribute assignment (a lock fished out of a
+registry dict); ``.acquire()``/``.release()`` held regions outside
+``with`` (the lifecycle index pairs those, but they carry no held-set).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
+
+
+def _short(key: tuple) -> str:
+    """Display form of a canonical (module, short_id) lock key."""
+    return key[1]
 
 
 class LockOrderInversion:
@@ -44,40 +54,23 @@ class LockOrderInversion:
     def check(self, mod):
         if mod.project is None:
             return
-        # edge (A, B) -> first (node, method) that took B while holding A
-        edges: Dict[Tuple[str, str], tuple] = {}
+        index = mod.project.concurrency
+        edges = index.global_lock_edges()
 
-        def add_edge(held, lock, node, where):
-            for h in held:
-                if h != lock and (h, lock) not in edges:
-                    edges[(h, lock)] = (node, where)
-
-        for cc in mod.project.concurrency.classes(mod.path):
-            for mc in cc.methods.values():
-                for acq in mc.acquisitions:
-                    add_edge(acq.held_before, acq.lock, acq.node,
-                             f"{cc.name}.{mc.name}")
-                for call in mc.self_calls:
-                    if not call.held:
-                        continue
-                    callee = cc.methods.get(call.callee)
-                    if callee is None:
-                        continue
-                    # one call hop: locks the callee acquires are taken
-                    # while the caller's held set is still held
-                    for acq in callee.acquisitions:
-                        add_edge(call.held, acq.lock, call.node,
-                                 f"{cc.name}.{mc.name} -> {call.callee}")
-
-        adj: Dict[str, List[str]] = {}
+        adj: Dict[tuple, List[tuple]] = {}
         for (a, b) in edges:
             adj.setdefault(a, []).append(b)
         for a in adj:
             adj[a].sort()
 
+        # walk edges in deterministic (path, line, edge) order; the first
+        # edge that closes each cycle owns the finding, and only the
+        # module that owns it reports — one finding per cycle, stable
+        # regardless of which module the runner visits first
         seen_cycles = set()
         for (a, b) in sorted(
-                edges, key=lambda e: (edges[e][0].lineno, e)):
+                edges,
+                key=lambda e: (edges[e][0], edges[e][1].lineno, e)):
             path = self._path(adj, b, a)
             if path is None:
                 continue
@@ -86,26 +79,30 @@ class LockOrderInversion:
             if key in seen_cycles:
                 continue
             seen_cycles.add(key)
-            node, where = edges[(a, b)]
+            epath, node, where = edges[(a, b)]
+            if epath != mod.path:
+                continue
             hops = []
             for i in range(len(cycle) - 1):
                 e = edges.get((cycle[i], cycle[i + 1]))
-                loc = (f"{mod.path}:{e[0].lineno} in {e[1]}"
+                loc = (f"{e[0]}:{e[1].lineno} in {e[2]}"
                        if e else "resolved hop")
                 hops.append(
-                    f"`{cycle[i]}` -> `{cycle[i + 1]}` ({loc})")
+                    f"`{_short(cycle[i])}` -> `{_short(cycle[i + 1])}` "
+                    f"({loc})")
+            names = ' -> '.join(f'`{_short(c)}`' for c in cycle)
             yield mod.finding(
                 self.code,
-                f"lock-order inversion: taking `{b}` while holding `{a}` "
-                f"(in {where}) closes the cycle "
-                f"{' -> '.join(f'`{c}`' for c in cycle)} — two threads "
-                f"entering these regions concurrently can deadlock; pick "
-                f"one global acquisition order [{'; '.join(hops)}]",
+                f"lock-order inversion: taking `{_short(b)}` while "
+                f"holding `{_short(a)}` (in {where}) closes the cycle "
+                f"{names} — two threads entering these regions "
+                f"concurrently can deadlock; pick one global acquisition "
+                f"order [{'; '.join(hops)}]",
                 node,
             ), node
 
     @staticmethod
-    def _path(adj, start: str, goal: str) -> Optional[List[str]]:
+    def _path(adj, start, goal) -> Optional[List[tuple]]:
         """Deterministic DFS path start -> ... -> goal, as a node list
         ending at goal (start included first), else None."""
         stack = [(start, [start])]

@@ -596,6 +596,14 @@ class MicroBatcher:
                     self._release_slot()
                     continue
                 total = sum(r.rows.shape[0] for r in live)
+                # lane = the replica this flush was routed to (stamped by
+                # the engine's dispatch); run_fn handles and fakes without
+                # one ride lane 0. Modulo guards a swap to a wider engine.
+                # Computed BEFORE the flight span opens: nothing that can
+                # raise sits between async_begin and the lane append, so
+                # the span cannot be stranded open with riders unfinished.
+                lane = getattr(handle, "lane", None)
+                lane = 0 if lane is None else int(lane) % self._lane_count
                 if flight_id is not None:
                     TRACER.complete(
                         "serve.batcher.dispatch", t0, time.perf_counter(),
@@ -604,15 +612,13 @@ class MicroBatcher:
                          "riders": [r.trace_id for r in live]})
                     TRACER.async_begin("serve.flight", flight_id,
                                        {"kind": live[0].kind, "rows": total})
-                # lane = the replica this flush was routed to (stamped by
-                # the engine's dispatch); run_fn handles and fakes without
-                # one ride lane 0. Modulo guards a swap to a wider engine.
-                lane = getattr(handle, "lane", None)
-                lane = 0 if lane is None else int(lane) % self._lane_count
                 with self._lock:
-                    self._stages.add("assemble", time.perf_counter() - t0)
+                    # append FIRST: once the entry is in the lane the
+                    # completer owns the flight span, so a raise in the
+                    # stats call below cannot strand it open
                     self._lanes[lane].append(
                         _Inflight(live, handle, total, flight_id, engine))
+                    self._stages.add("assemble", time.perf_counter() - t0)
                     self._dispatching_on = None
                     self._cv.notify_all()
         finally:

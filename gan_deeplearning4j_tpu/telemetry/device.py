@@ -126,9 +126,12 @@ def capture_async(artifacts_dir: str, duration_ms: int = 1000
     its failure) finishes."""
     if duration_ms < 1:
         raise ValueError("duration_ms must be >= 1")
+    # the output path is composed BEFORE taking the capture lock: a
+    # failure here must not strand the lock held with no thread to
+    # release it (every later capture would 409 forever)
+    out = _capture_dir(artifacts_dir)
     if not _capture_lock.acquire(blocking=False):
         raise CaptureBusy("a device capture is already in progress")
-    out = _capture_dir(artifacts_dir)
     t = threading.Thread(
         target=_swallow_owned, args=(out, duration_ms),
         name="device-trace-capture", daemon=True,

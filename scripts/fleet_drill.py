@@ -801,8 +801,10 @@ def run_alerts(args) -> int:
         load.start()
         time.sleep(4.0)  # settle: baselines arm, boot noise ages out
         monitor.open_window("calm_1")
-        time.sleep(calm_audit_s)
-        monitor.close_window()
+        try:
+            time.sleep(calm_audit_s)
+        finally:
+            monitor.close_window()
         invariants["calm1_zero_firing"] = not any(
             f["window"] == "calm_1" for f in monitor.false_fires)
         log(f"calm-1 audit done ({calm_audit_s:.0f}s, firing seen: "
@@ -910,8 +912,10 @@ def run_alerts(args) -> int:
 
         # -- phase 6: calm again — still nothing may fire ----------------
         monitor.open_window("calm_2")
-        time.sleep(calm_audit_s)
-        monitor.close_window()
+        try:
+            time.sleep(calm_audit_s)
+        finally:
+            monitor.close_window()
         invariants["calm2_zero_firing"] = not any(
             f["window"] == "calm_2" for f in monitor.false_fires)
         monitor.finish()

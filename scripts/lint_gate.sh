@@ -28,6 +28,13 @@
 cd "$(dirname "$0")/.." || exit 2
 TARGETS=(gan_deeplearning4j_tpu bench.py scripts)
 FORMAT="${LINT_FORMAT:-text}"
+# Incremental parse cache: every shape (fast, --full, --mux) shares one
+# content-addressed cache so repeat invocations — pre-commit after CI,
+# the campaign's SARIF pass after its gate pass — skip re-parsing
+# unchanged files. JAXLINT_CACHE_DIR overrides the location;
+# LINT_CACHE=off bypasses the cache entirely (the analyzer honors it
+# even when the dir is exported).
+export JAXLINT_CACHE_DIR="${JAXLINT_CACHE_DIR:-${TMPDIR:-/tmp}/jaxlint_cache}"
 EXTRA=()
 [ -n "${LINT_PROFILE:-}" ] && EXTRA+=(--profile)
 if [ "$1" = "--full" ]; then

@@ -29,8 +29,14 @@ fi
 # Auditable artifact: the SARIF snapshot of the gate the campaign ran
 # under lands next to the BENCH records, so "what did the analyzer say
 # about the exact tree that produced these numbers" has a durable answer.
+# The same pass snapshots the lifecycle-index stats (paired-resource
+# opens/transfers/leaks the JG027-29 rules saw) beside it. This second
+# invocation rides the parse cache the gate run above just warmed
+# (lint_gate.sh exports JAXLINT_CACHE_DIR), so it costs roughly the
+# rules phase, not a second full parse.
 mkdir -p artifacts
 LINT_FORMAT=sarif bash scripts/lint_gate.sh --full \
+  --lifecycle-stats artifacts/lint_lifecycle_stats.json \
   > artifacts/lint_gate.sarif 2>> tpu_poller.log \
   || echo "$(date +%H:%M:%S) sarif artifact emission failed (gate already passed — continuing)" >> tpu_poller.log
 # Serving smoke (CPU, small fixed shape): the campaign ships artifacts a

@@ -3618,6 +3618,422 @@ class TestBlockingCallUnderLock:
 
 
 # ===========================================================================
+# JG027 — leaked paired resource (lifecycle index)
+# ===========================================================================
+
+class TestLeakedPairedResource:
+    def test_true_positive_early_exit(self):
+        # the PR 8 router shape: a token taken, then a guard clause
+        # returns without giving it back
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def work(items):\n"
+            "    LOCK.acquire()\n"
+            "    if not items:\n"
+            "        return None\n"
+            "    LOCK.release()\n"
+            "    return items\n"
+        )
+        assert codes(r) == ["JG027"]
+        assert "early exit" in r.active[0].message
+        assert r.active[0].line == 4  # anchored at the open, not the exit
+
+    def test_true_positive_exception_path(self):
+        # the PR 6 device-capture shape: a raise-capable call sits in the
+        # unprotected gap between acquire and release
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def snap(load, path):\n"
+            "    LOCK.acquire()\n"
+            "    data = load(path)\n"
+            "    LOCK.release()\n"
+            "    return data\n"
+        )
+        assert codes(r) == ["JG027"]
+        assert "exception" in r.active[0].message
+
+    def test_true_positive_partial_branch_close(self):
+        # closed on one arm only, then control falls off the end
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def partial(flag):\n"
+            "    LOCK.acquire()\n"
+            "    if flag:\n"
+            "        LOCK.release()\n"
+        )
+        assert codes(r) == ["JG027"]
+
+    def test_true_positive_inflight_counter(self):
+        # the PR 4 ledger shape: += opens a reservation the -= must
+        # release on every path out
+        r = run(
+            "class Ledger:\n"
+            "    def __init__(self):\n"
+            "        self.inflight = 0\n"
+            "    def submit(self, item):\n"
+            "        self.inflight += 1\n"
+            "        if item is None:\n"
+            "            return False\n"
+            "        self.inflight -= 1\n"
+            "        return True\n"
+        )
+        assert codes(r) == ["JG027"]
+        assert "self.inflight" in r.active[0].message
+
+    def test_true_positive_inferred_pair(self):
+        # no seeded name involved: open_stream/close_stream is inferred
+        # from the class' dual method names sharing a self attribute
+        r = run(
+            "class StreamPool:\n"
+            "    def __init__(self):\n"
+            "        self._streams = []\n"
+            "    def open_stream(self):\n"
+            "        s = object()\n"
+            "        self._streams.append(s)\n"
+            "        return s\n"
+            "    def close_stream(self, s):\n"
+            "        self._streams.remove(s)\n"
+            "def use():\n"
+            "    pool = StreamPool()\n"
+            "    s = pool.open_stream()\n"
+            "    if s is None:\n"
+            "        return None\n"
+            "    pool.close_stream(s)\n"
+            "    return s\n"
+        )
+        assert codes(r) == ["JG027"]
+        assert "open_stream" in r.active[0].message
+
+    def test_true_negative_try_finally(self):
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def snap(load, path):\n"
+            "    LOCK.acquire()\n"
+            "    try:\n"
+            "        data = load(path)\n"
+            "    finally:\n"
+            "        LOCK.release()\n"
+            "    return data\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_closed_on_every_branch(self):
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def both(flag):\n"
+            "    LOCK.acquire()\n"
+            "    if flag:\n"
+            "        LOCK.release()\n"
+            "    else:\n"
+            "        LOCK.release()\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_ownership_returned(self):
+        # the token leaves with the return value: the caller now owes the
+        # refund, this frame is clean
+        r = run(
+            "def lease(BUDGET):\n"
+            "    tok = BUDGET.take(1)\n"
+            "    return tok\n"
+            "def give_back(BUDGET, tok):\n"
+            "    BUDGET.refund(tok)\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_start_stop_instance_idiom(self):
+        # the close-half lives in a sibling method: the INSTANCE holds
+        # the resource between start() and stop() — a transfer, not a leak
+        r = run(
+            "import threading\n"
+            "class Pump:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def start(self):\n"
+            "        self._lock.acquire()\n"
+            "    def stop(self):\n"
+            "        self._lock.release()\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_seeded_open_without_close_half_in_module(self):
+        # atexit.register in a module that never unregisters is a
+        # fire-and-forget API, not half of a protocol
+        r = run(
+            "import atexit\n"
+            "def hook(fn):\n"
+            "    atexit.register(fn)\n"
+            "    return None\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG028 — unbalanced release
+# ===========================================================================
+
+class TestUnbalancedRelease:
+    def test_true_positive_double_close(self):
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def bad():\n"
+            "    LOCK.acquire()\n"
+            "    LOCK.release()\n"
+            "    LOCK.release()\n"
+        )
+        assert codes(r) == ["JG028"]
+        assert "twice" in r.active[0].message
+
+    def test_true_positive_double_close_via_branch(self):
+        # one arm closes, the surviving path closes again
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def toggle(flag):\n"
+            "    LOCK.acquire()\n"
+            "    if flag:\n"
+            "        LOCK.release()\n"
+            "    LOCK.release()\n"
+        )
+        assert codes(r) == ["JG028"]
+
+    def test_true_positive_close_without_open(self):
+        # conditional open, unconditional close: the refund-without-take
+        # shape that drives a ledger negative
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def maybe(flag):\n"
+            "    if flag:\n"
+            "        LOCK.acquire()\n"
+            "    LOCK.release()\n"
+        )
+        assert codes(r) == ["JG028"]
+        assert "never ran" in r.active[0].message
+
+    def test_true_positive_loop_carried_release(self):
+        # one open before the loop, the close inside the body: released
+        # zero times or N times, never exactly once
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def pump(items):\n"
+            "    LOCK.acquire()\n"
+            "    for it in items:\n"
+            "        LOCK.release()\n"
+        )
+        assert codes(r) == ["JG028"]
+        assert "loop" in r.active[0].message
+
+    def test_true_negative_close_then_reopen(self):
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def cycle():\n"
+            "    LOCK.acquire()\n"
+            "    LOCK.release()\n"
+            "    LOCK.acquire()\n"
+            "    LOCK.release()\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_branch_exit_then_close(self):
+        # `close(); return` arm followed by a close on the surviving path
+        # is exactly-once on both paths — not a double-close
+        r = run(
+            "import threading\n"
+            "LOCK = threading.Lock()\n"
+            "def guard(flag):\n"
+            "    LOCK.acquire()\n"
+            "    if flag:\n"
+            "        LOCK.release()\n"
+            "        return None\n"
+            "    LOCK.release()\n"
+            "    return True\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG029 — handoff without transfer
+# ===========================================================================
+
+class TestHandoffWithoutTransfer:
+    def test_true_positive_thread_target_never_closes(self):
+        # the pre-PR 6 device-capture bug: the lock is acquired, the
+        # worker thread is handed ownership, and the worker never releases
+        r = run(
+            "import threading\n"
+            "class Cap:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def grab(self):\n"
+            "        self._lock.acquire()\n"
+            "        threading.Thread(target=self._work, daemon=True).start()\n"
+            "    def _work(self):\n"
+            "        pass\n"
+            "    def drop(self):\n"
+            "        self._lock.release()\n"
+        )
+        assert codes(r) == ["JG029"]
+        assert "self._work" in r.active[0].message
+
+    def test_true_negative_receiver_closes_in_finally(self):
+        # the PR 6 fix itself: the spawned worker releases in its finally
+        # — the correct ownership-transfer idiom must not be punished
+        r = run(
+            "import threading\n"
+            "class Cap:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def grab(self):\n"
+            "        self._lock.acquire()\n"
+            "        threading.Thread(target=self._work, daemon=True).start()\n"
+            "    def _work(self):\n"
+            "        try:\n"
+            "            pass\n"
+            "        finally:\n"
+            "            self._lock.release()\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_unresolvable_target(self):
+        # a target the project index cannot read stays a silent transfer:
+        # the analyzer only indicts code it can actually see
+        r = run(
+            "import threading\n"
+            "class Cap:\n"
+            "    def __init__(self, fn):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._fn = fn\n"
+            "    def grab(self):\n"
+            "        self._lock.acquire()\n"
+            "        threading.Thread(target=self._fn, daemon=True).start()\n"
+            "    def drop(self):\n"
+            "        self._lock.release()\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
+# JG025 cross-class unification (satellite on the concurrency index)
+# ===========================================================================
+
+class TestCrossClassLockOrder:
+    MANAGER = (
+        "import threading\n"
+        "from fx.worker import Worker\n"
+        "class Manager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._state_lock = threading.Lock()\n"
+        "        self.worker = Worker(lock=self._lock,\n"
+        "                             state_lock=self._state_lock)\n"
+        "    def roll(self):\n"
+        "        with self._state_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )
+    WORKER = (
+        "class Worker:\n"
+        "    def __init__(self, lock, state_lock):\n"
+        "        self._lk = lock\n"
+        "        self._st = state_lock\n"
+        "    def tick(self):\n"
+        "        with self._lk:\n"
+        "            with self._st:\n"
+        "                pass\n"
+    )
+
+    def test_true_positive_constructor_injected_inversion(self):
+        # the documented JG025 false negative this satellite closes: the
+        # manager nests state_lock->lock, the worker it constructed around
+        # the SAME two locks nests lock->state_lock — neither module alone
+        # contains a cycle
+        report = analyze_sources({"fx/manager.py": self.MANAGER,
+                                  "fx/worker.py": self.WORKER})
+        assert [f.code for f in report.active] == ["JG025"]
+        f = report.active[0]
+        assert "Manager._lock" in f.message
+        assert "Manager._state_lock" in f.message
+
+    def test_true_positive_attribute_planted_inversion(self):
+        # second sharing route: the locks are planted onto the worker by
+        # attribute assignment after construction
+        manager = (
+            "import threading\n"
+            "from fx.worker import Worker\n"
+            "class Manager:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._state_lock = threading.Lock()\n"
+            "        self.worker = Worker()\n"
+            "        self.worker._lk = self._lock\n"
+            "        self.worker._st = self._state_lock\n"
+            "    def roll(self):\n"
+            "        with self._state_lock:\n"
+            "            with self._lock:\n"
+            "                pass\n"
+        )
+        worker = (
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lk = None\n"
+            "        self._st = None\n"
+            "    def tick(self):\n"
+            "        with self._lk:\n"
+            "            with self._st:\n"
+            "                pass\n"
+        )
+        report = analyze_sources({"fx/manager.py": manager,
+                                  "fx/worker.py": worker})
+        assert [f.code for f in report.active] == ["JG025"]
+
+    def test_finding_lands_once_in_the_closing_module(self):
+        report = analyze_sources({"fx/manager.py": self.MANAGER,
+                                  "fx/worker.py": self.WORKER})
+        assert [f.path for f in report.active] == ["fx/manager.py"]
+
+    def test_true_negative_consistent_order_across_classes(self):
+        worker = self.WORKER.replace(
+            "        with self._lk:\n"
+            "            with self._st:\n",
+            "        with self._st:\n"
+            "            with self._lk:\n")
+        report = analyze_sources({"fx/manager.py": self.MANAGER,
+                                  "fx/worker.py": worker})
+        assert [f.code for f in report.active] == []
+
+    def test_true_negative_unshared_locks_do_not_unify(self):
+        # same nesting shapes but the worker builds its OWN locks: no
+        # injection route, no unification, no project-wide cycle
+        manager = self.MANAGER.replace(
+            "        self.worker = Worker(lock=self._lock,\n"
+            "                             state_lock=self._state_lock)\n",
+            "        self.worker = Worker()\n")
+        worker = (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self._lk = threading.Lock()\n"
+            "        self._st = threading.Lock()\n"
+            "    def tick(self):\n"
+            "        with self._lk:\n"
+            "            with self._st:\n"
+            "                pass\n"
+        )
+        report = analyze_sources({"fx/manager.py": manager,
+                                  "fx/worker.py": worker})
+        assert [f.code for f in report.active] == []
+
+
+# ===========================================================================
 # Satellites: deterministic emission, --profile, gate staleness
 # ===========================================================================
 
@@ -3659,6 +4075,27 @@ class TestDeterministicEmission:
         for part in (r.active, r.suppressed, r.baselined):
             assert [key(f) for f in part] == sorted(key(f) for f in part)
         assert r.warnings == sorted(r.warnings)
+
+    def test_lifecycle_findings_are_order_stable(self):
+        # the lifecycle rules (JG027-29) build a lazy project-wide index;
+        # their findings must be byte-stable across enumeration order too
+        srcs = {
+            "fx/leak.py": (
+                "import threading\n"
+                "LOCK = threading.Lock()\n"
+                "def f(x):\n"
+                "    LOCK.acquire()\n"
+                "    if x:\n"
+                "        return None\n"
+                "    LOCK.release()\n"
+            ),
+            "fx/clean.py": "def g(y):\n    return y\n",
+        }
+        r1 = analyze_sources(dict(srcs))
+        r2 = analyze_sources(dict(reversed(list(srcs.items()))))
+        assert [f.code for f in r1.active] == ["JG027"]
+        assert r1.render_text() == r2.render_text()
+        assert json.dumps(r1.to_json()) == json.dumps(r2.to_json())
 
 
 class TestProfile:
@@ -3749,3 +4186,229 @@ class TestLintGateScript:
                           env={"LINT_PROFILE": "1"})
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "--profile (wall seconds)" in proc.stderr
+
+    def test_gate_wires_the_parse_cache(self, tmp_path):
+        # lint_gate.sh exports JAXLINT_CACHE_DIR so every shape shares one
+        # cache; the profile table proves the analyzer picked it up
+        proc = self._gate("--full", "--rules", "JG003",
+                          env={"LINT_PROFILE": "1",
+                               "JAXLINT_CACHE_DIR": str(tmp_path)})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "cache hits" in proc.stderr
+
+    def test_lint_cache_off_bypasses_the_gate_cache(self, tmp_path):
+        proc = self._gate("--full", "--rules", "JG003",
+                          env={"LINT_PROFILE": "1", "LINT_CACHE": "off",
+                               "JAXLINT_CACHE_DIR": str(tmp_path)})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "cache hits" not in proc.stderr
+
+
+# ===========================================================================
+# Satellites: parse cache, fingerprint v2 + migration, --changed-only scoping
+# ===========================================================================
+
+class TestParseCache:
+    LEAKY = (
+        "import threading\n"
+        "LOCK = threading.Lock()\n"
+        "def f(x):\n"
+        "    LOCK.acquire()\n"
+        "    if x:\n"
+        "        return None\n"
+        "    LOCK.release()\n"
+    )
+    CLEAN = "def g(y):\n    return y\n"
+
+    def _tree(self, tmp_path):
+        (tmp_path / "leaky.py").write_text(self.LEAKY)
+        (tmp_path / "clean.py").write_text(self.CLEAN)
+
+    def _run(self, tmp_path, cache):
+        return analyze_paths(["leaky.py", "clean.py"], root=str(tmp_path),
+                             cache=cache)
+
+    def test_warm_run_equals_cold_run_finding_for_finding(self, tmp_path):
+        from gan_deeplearning4j_tpu.analysis import engine
+
+        self._tree(tmp_path)
+        cold_cache = engine.ParseCache(str(tmp_path / "cache"))
+        cold = self._run(tmp_path, cold_cache)
+        assert cold_cache.stats == {"hits": 0, "misses": 2}
+        warm_cache = engine.ParseCache(str(tmp_path / "cache"))
+        warm = self._run(tmp_path, warm_cache)
+        assert warm_cache.stats == {"hits": 2, "misses": 0}
+        assert [f.code for f in cold.active] == ["JG027"]
+        assert cold.render_text() == warm.render_text()
+        assert json.dumps(cold.to_json()) == json.dumps(warm.to_json())
+
+    def test_edit_invalidates_exactly_that_file(self, tmp_path):
+        from gan_deeplearning4j_tpu.analysis import engine
+
+        self._tree(tmp_path)
+        self._run(tmp_path, engine.ParseCache(str(tmp_path / "cache")))
+        (tmp_path / "leaky.py").write_text(self.LEAKY + "# touched\n")
+        cache = engine.ParseCache(str(tmp_path / "cache"))
+        r = self._run(tmp_path, cache)
+        assert cache.stats == {"hits": 1, "misses": 1}
+        assert [f.code for f in r.active] == ["JG027"]
+
+    def test_corrupt_entry_degrades_to_miss(self, tmp_path):
+        from gan_deeplearning4j_tpu.analysis import engine
+
+        self._tree(tmp_path)
+        cold = self._run(tmp_path, engine.ParseCache(str(tmp_path / "cache")))
+        for blob in (tmp_path / "cache").iterdir():
+            blob.write_bytes(b"not a pickle")
+        cache = engine.ParseCache(str(tmp_path / "cache"))
+        r = self._run(tmp_path, cache)
+        assert cache.stats == {"hits": 0, "misses": 2}
+        assert r.render_text() == cold.render_text()
+
+    def test_cli_cache_dir_profile_and_identical_output(self, tmp_path):
+        p = tmp_path / "dirty.py"
+        p.write_text("def f(x):\n    assert x\n    return x\n")
+        args = [sys.executable, "-m", "gan_deeplearning4j_tpu.analysis",
+                str(p), "--no-baseline", "--rules", "JG003", "--profile",
+                "--cache-dir", str(tmp_path / "cache")]
+        p1 = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+        p2 = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+        assert p1.returncode == p2.returncode == 1
+        assert "cache hits 0 / misses 1" in p1.stderr
+        assert "cache hits 1 / misses 0" in p2.stderr
+        assert p1.stdout == p2.stdout
+
+    def test_cli_lint_cache_off_escape_hatch(self, tmp_path):
+        p = tmp_path / "dirty.py"
+        p.write_text("def f(x):\n    assert x\n    return x\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "gan_deeplearning4j_tpu.analysis",
+             str(p), "--no-baseline", "--rules", "JG003", "--profile",
+             "--cache-dir", str(tmp_path / "cache")],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "LINT_CACHE": "off"},
+        )
+        assert proc.returncode == 1
+        assert "cache hits" not in proc.stderr
+
+
+class TestChangedOnlyScoping:
+    def test_check_paths_restricts_the_rule_phase(self, tmp_path):
+        (tmp_path / "a.py").write_text("def f(x):\n    assert x\n")
+        (tmp_path / "b.py").write_text("def g(y):\n    assert y\n")
+        r = analyze_paths(["a.py", "b.py"], root=str(tmp_path),
+                          check_paths={"a.py"})
+        assert [f.path for f in r.active] == ["a.py"]
+
+    def test_unchecked_files_still_feed_the_index(self, tmp_path):
+        # the point of parsing the full target set under --changed-only:
+        # a cross-module rule checking only rules.py must still see the
+        # metric family registered in (unchanged) metrics.py
+        (tmp_path / "metrics.py").write_text(
+            "from gan_deeplearning4j_tpu.telemetry.registry import get_registry\n"
+            "def families():\n"
+            "    get_registry().gauge('fleet_pressure_real', 'x')\n"
+        )
+        (tmp_path / "rules.py").write_text(
+            "from gan_deeplearning4j_tpu.telemetry.alerts import AlertRule\n"
+            "def rules():\n"
+            "    return [AlertRule(name='p', kind='anomaly',\n"
+            "                      metric='fleet_pressure_reel')]\n"
+        )
+        r = analyze_paths(["metrics.py", "rules.py"], root=str(tmp_path),
+                          check_paths={"rules.py"})
+        assert [f.code for f in r.active] == ["JG023"]
+        assert "fleet_pressure_reel" in r.active[0].message
+
+    def test_baseline_staleness_is_scoped_to_checked_files(self, tmp_path):
+        # an entry for an UNCHECKED file must not read as stale just
+        # because the rule phase skipped that file this run
+        (tmp_path / "a.py").write_text("def f(x):\n    assert x\n")
+        (tmp_path / "b.py").write_text("def g(y):\n    return y\n")
+        baseline = [{"fingerprint": "deadbeefdeadbeef", "rule": "JG003",
+                     "path": "a.py", "justification": "someone else's"}]
+        r = analyze_paths(["a.py", "b.py"], root=str(tmp_path),
+                          baseline=baseline, check_paths={"b.py"})
+        assert r.active == [] and r.stale_baseline == []
+        full = analyze_paths(["a.py", "b.py"], root=str(tmp_path),
+                             baseline=baseline)
+        assert full.stale_baseline != []  # the full run still catches it
+
+
+class TestFingerprintV2:
+    def test_context_disambiguates_identical_snippets(self):
+        # two byte-identical offending lines in one file: the legacy
+        # scheme collides, the neighbor-context scheme does not
+        r = run("def f(x):\n    assert x\n    y = 1\n    assert x\n")
+        assert [f.code for f in r.active] == ["JG003", "JG003"]
+        a, b = r.active
+        assert a.legacy_fingerprint == b.legacy_fingerprint
+        assert a.fingerprint != b.fingerprint
+
+    def test_spacing_only_edit_keeps_the_fingerprint(self):
+        a = run("def f(x):\n    assert x\n    return x\n").active[0]
+        b = run("def f(x):\n\n    assert x\n\n    return x\n").active[0]
+        assert a.fingerprint == b.fingerprint
+
+    def test_neighbor_edit_stales_the_fingerprint(self):
+        a = run("def f(x):\n    assert x\n    return x\n").active[0]
+        b = run("def f(x):\n    assert x\n    return x + 1\n").active[0]
+        assert a.fingerprint != b.fingerprint
+
+    def test_legacy_entry_matches_and_records_the_migration(self):
+        src = "def f(x):\n    assert x\n    return x\n"
+        probe = analyze_source(src, path="fx/mod.py").active[0]
+        baseline = [{"fingerprint": probe.legacy_fingerprint,
+                     "rule": "JG003", "path": "fx/mod.py",
+                     "justification": "pre-migration entry"}]
+        r = analyze_source(src, path="fx/mod.py", baseline=baseline)
+        assert r.active == []
+        assert [f.code for f in r.baselined] == ["JG003"]
+        assert r.stale_baseline == []
+        assert r.baseline_migrations == {
+            probe.legacy_fingerprint: probe.fingerprint}
+
+    def test_current_entry_records_no_migration(self):
+        src = "def f(x):\n    assert x\n    return x\n"
+        probe = analyze_source(src, path="fx/mod.py").active[0]
+        baseline = [{"fingerprint": probe.fingerprint, "rule": "JG003",
+                     "path": "fx/mod.py", "justification": "current"}]
+        r = analyze_source(src, path="fx/mod.py", baseline=baseline)
+        assert r.active == [] and r.baseline_migrations == {}
+
+    def test_cli_auto_migrates_the_baseline_file(self, tmp_path):
+        src = "def f(x):\n    assert x\n    return x\n"
+        (tmp_path / "dirty.py").write_text(src)
+        probe = analyze_source(src, path="dirty.py").active[0]
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"entries": [
+            {"fingerprint": probe.legacy_fingerprint, "rule": "JG003",
+             "path": "dirty.py", "justification": "pre-migration entry"}
+        ]}))
+        args = [sys.executable, "-m", "gan_deeplearning4j_tpu.analysis",
+                "dirty.py", "--rules", "JG003", "--baseline", str(bl)]
+        env = {**os.environ, "PYTHONPATH": REPO}
+        p1 = subprocess.run(args, capture_output=True, text=True,
+                            cwd=str(tmp_path), env=env)
+        assert p1.returncode == 0, p1.stdout + p1.stderr
+        assert "migrated 1 baseline entry" in p1.stderr
+        entries = json.loads(bl.read_text())["entries"]
+        assert entries[0]["fingerprint"] == probe.fingerprint
+        # second run matches directly: no further rewrite
+        p2 = subprocess.run(args, capture_output=True, text=True,
+                            cwd=str(tmp_path), env=env)
+        assert p2.returncode == 0 and "migrated" not in p2.stderr
+
+    def test_cli_lifecycle_stats_artifact(self, tmp_path):
+        (tmp_path / "leaky.py").write_text(TestParseCache.LEAKY)
+        out = tmp_path / "stats.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "gan_deeplearning4j_tpu.analysis",
+             "leaky.py", "--no-baseline", "--lifecycle-stats", str(out)],
+            capture_output=True, text=True, cwd=str(tmp_path),
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 1  # the leak is an active finding
+        stats = json.loads(out.read_text())
+        assert stats["opens"] >= 1 and stats["leaked"] >= 1
+        assert stats["pairs_seeded"] >= 5

@@ -598,6 +598,23 @@ class TestSignalCapture:
         finally:
             signal.signal(signal.SIGUSR2, old)
 
+    def test_path_failure_does_not_strand_the_capture_lock(self, monkeypatch):
+        # regression (JG027 lifecycle audit): capture_async composes the
+        # output path BEFORE taking the capture lock — if that step raised
+        # after the acquire there would be no thread to release, and every
+        # later capture would 409 forever
+        from gan_deeplearning4j_tpu.telemetry import device
+
+        def boom(_artifacts_dir):
+            raise OSError("disk gone")
+
+        monkeypatch.setattr(device, "_capture_dir", boom)
+        with pytest.raises(OSError):
+            device.capture_async("anywhere")
+        assert device._capture_lock.acquire(blocking=False), (
+            "capture lock left held after a failed capture_async")
+        device._capture_lock.release()
+
 
 # ===========================================================================
 # trace_report: the campaign gate
