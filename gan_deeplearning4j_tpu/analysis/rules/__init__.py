@@ -56,6 +56,9 @@ from gan_deeplearning4j_tpu.analysis.rules.alert_metrics import (
 from gan_deeplearning4j_tpu.analysis.rules.shared_state import (
     UnguardedSharedMutableState,
 )
+from gan_deeplearning4j_tpu.analysis.rules.quant_dtype import (
+    QuantPrecisionCastMismatch,
+)
 from gan_deeplearning4j_tpu.analysis.rules.lock_order import (
     LockOrderInversion,
 )
@@ -102,6 +105,7 @@ RULES = [
     LeakedPairedResource(),
     UnbalancedRelease(),
     HandoffWithoutTransfer(),
+    QuantPrecisionCastMismatch(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -22,6 +22,7 @@ from gan_deeplearning4j_tpu.nn.layers import (
     OutputLayer,
     SubsamplingLayer,
     Upsampling2D,
+    register_layer,
 )
 from gan_deeplearning4j_tpu.nn.preprocessors import (
     CnnToFeedForwardPreProcessor,
@@ -45,6 +46,7 @@ __all__ = [
     "Upsampling2D",
     "CnnToFeedForwardPreProcessor",
     "FeedForwardToCnnPreProcessor",
+    "register_layer",
     "ComputationGraph",
     "GraphBuilder",
     "GraphConfig",

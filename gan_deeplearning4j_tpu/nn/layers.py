@@ -350,10 +350,29 @@ _LAYER_CLASSES = {
     )
 }
 
+#: layer types owned by optional subsystems, resolved on first use so a
+#: quantized checkpoint round-trips without nn/ importing quant/ (and a
+#: process that never loads one pays no import)
+_EXTERNAL_LAYER_MODULES = {
+    "QuantDenseLayer": "gan_deeplearning4j_tpu.quant.layers",
+}
+
+
+def register_layer(cls):
+    """Register a Layer subclass for ``layer_from_dict`` resolution — the
+    extension point quant/ (and any future subsystem with its own layer
+    types) registers through. Usable as a class decorator."""
+    _LAYER_CLASSES[cls.__name__] = cls
+    return cls
+
 
 def layer_from_dict(d: dict) -> Layer:
     d = dict(d)
     kind = d.pop("type")
+    if kind not in _LAYER_CLASSES and kind in _EXTERNAL_LAYER_MODULES:
+        import importlib
+
+        importlib.import_module(_EXTERNAL_LAYER_MODULES[kind])
     if kind not in _LAYER_CLASSES:
         raise KeyError(f"unknown layer type {kind!r}")
     if d.get("updater") is not None:

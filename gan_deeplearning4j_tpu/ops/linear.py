@@ -8,6 +8,7 @@ precision is enabled) with float32 accumulation — the TPU-native fast path.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from gan_deeplearning4j_tpu.runtime.dtype import get_compute_dtype
@@ -24,6 +25,41 @@ def dense(x, w, b=None):
     out_dtype = x.dtype
     cdt = get_compute_dtype()
     y = jnp.matmul(x.astype(cdt), w.astype(cdt), preferred_element_type=jnp.float32)
+    y = y.astype(out_dtype)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def quant_dense(x, w_q, w_scale, b, act_scale):
+    """Dequant-at-matmul int8 dense: float activations in, float out.
+
+    The post-training-quantized serving path (docs/QUANT.md): weights are
+    stored int8 with a per-output-channel symmetric scale, activations are
+    quantized on entry against a calibrated per-layer scale, the
+    contraction runs int8×int8 with an int32 accumulator
+    (``preferred_element_type`` — the hardware's integer-MAC path), and
+    the single dequant multiply happens once on the accumulator. The wire
+    dtype never changes: callers see float rows exactly as with
+    :func:`dense`.
+
+    Args:
+      x: (batch, in) float activations.
+      w_q: (in, out) int8 kernel.
+      w_scale: (out,) float per-channel weight scales (w ≈ w_q * w_scale).
+      b: optional (out,) float bias (applied after dequant).
+      act_scale: python float activation scale (x ≈ x_q * act_scale) —
+        static, baked into the compiled executable.
+    """
+    out_dtype = x.dtype
+    x_q = jnp.clip(jnp.round(x * (1.0 / act_scale)), -127.0, 127.0)
+    x_q = x_q.astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = acc.astype(jnp.float32) * (w_scale.astype(jnp.float32) * act_scale)
     y = y.astype(out_dtype)
     if b is not None:
         y = y + b

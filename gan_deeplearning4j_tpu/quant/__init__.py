@@ -1,0 +1,56 @@
+"""quant/ — real quantized serving variants with measured cost.
+
+Three parts (docs/QUANT.md):
+
+- **variant builders** (:mod:`.variants`): a published serving bundle in,
+  a bf16 (params + compute end-to-end, generator/sampler) or int8
+  (per-channel symmetric PTQ of the discriminator-feature classifier,
+  activation scales calibrated on the canary's fixed seeded probe batch)
+  bundle out — a normal bundle whose manifest declares ``precision`` and
+  provenance, adopted by the existing store/watcher/reloader/mux
+  machinery unchanged.
+- **measured cost** (:mod:`.cost`): each built variant profiled on the
+  live device ladder (per-bucket latency, resident param bytes, staged
+  width) into a manifest ``cost`` block; the mux registry's residency
+  eviction and brownout shed ordering rank by the measurement, with the
+  operator-declared number kept only as the bootstrap default.
+- **quality gating**: nothing new — the deploy canary gate's relative
+  FID/accuracy thresholds (deploy/canary.py) police quantization loss at
+  adoption; an over-degraded variant is rejected through the existing
+  quarantine path, never served.
+
+The int8 forward pass is :class:`~.layers.QuantDenseLayer`
+(int8×int8→int32 with dequant at the matmul — outputs stay float).
+"""
+
+from gan_deeplearning4j_tpu.quant.cost import (
+    manifest_cost,
+    measure_bundle_cost,
+    measure_engine_cost,
+    write_cost_block,
+)
+from gan_deeplearning4j_tpu.quant.layers import QuantDenseLayer
+from gan_deeplearning4j_tpu.quant.variants import (
+    build_bf16_variant,
+    build_int8_variant,
+    calibrate_activation_scales,
+    cast_params_bf16,
+    default_calibration_rows,
+    quantize_classifier,
+    quantize_dense_params,
+)
+
+__all__ = [
+    "QuantDenseLayer",
+    "build_bf16_variant",
+    "build_int8_variant",
+    "calibrate_activation_scales",
+    "cast_params_bf16",
+    "default_calibration_rows",
+    "quantize_classifier",
+    "quantize_dense_params",
+    "manifest_cost",
+    "measure_bundle_cost",
+    "measure_engine_cost",
+    "write_cost_block",
+]

@@ -1,0 +1,70 @@
+"""QuantDenseLayer — the int8 post-training-quantized dense layer.
+
+The serving-side replacement ``quant/variants.py`` swaps in for a
+``DenseLayer``/``OutputLayer`` vertex of the discriminator-feature
+classifier: weights live as int8 with a per-output-channel symmetric
+scale (``w ≈ W_q * w_scale``), the activation scale is a *static* layer
+field calibrated once at build time on the canary's fixed seeded probe
+batch, and the forward pass is :func:`~...ops.linear.quant_dense`
+(int8×int8 → int32 accumulate, one dequant multiply). Inputs and outputs
+stay float — the wire contract and every downstream layer are unchanged.
+
+This is an inference-only layer: ``init`` exists only so the graph
+machinery can shape-check it (real parameters always come from
+quantizing a trained float checkpoint), and there is no loss attachment —
+a quantized graph is never trained, it is *built* from a trained one.
+
+Registered with the ``nn`` layer registry at import (``register_layer``),
+and lazily importable through ``layer_from_dict`` so a quantized bundle
+round-trips in a process that never imported quant/ explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from gan_deeplearning4j_tpu.nn.input_type import InputType
+from gan_deeplearning4j_tpu.nn.layers import Layer, register_layer
+from gan_deeplearning4j_tpu.ops import linear as linear_ops
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class QuantDenseLayer(Layer):
+    """Int8 dense with per-channel weight scales and a calibrated static
+    activation scale (module docstring)."""
+
+    n_out: int = 0
+    n_in: Optional[int] = None  # inferred from in_type when None
+    #: activation quantization scale (x ≈ round(x / act_scale) * act_scale)
+    #: — calibrated at build time, static in the compiled executable
+    act_scale: float = 1.0
+
+    def _n_in(self, in_type: InputType) -> int:
+        return self.n_in if self.n_in is not None else in_type.features
+
+    def init(self, key, in_type) -> Dict[str, jnp.ndarray]:
+        n_in = self._n_in(in_type)
+        return {
+            "W_q": jnp.zeros((n_in, self.n_out), jnp.int8),
+            "w_scale": jnp.ones((self.n_out,), jnp.float32),
+            "b": jnp.zeros((self.n_out,), jnp.float32),
+        }
+
+    def apply(self, params, x, *, train: bool, rng=None):
+        y = linear_ops.quant_dense(
+            x, params["W_q"], params["w_scale"], params["b"],
+            float(self.act_scale),
+        )
+        return self._act(y), None
+
+    def output_type(self, in_type):
+        return InputType.feed_forward(self.n_out)
+
+    def param_roles(self):
+        # w_scale is deliberately NOT a weight role: l2 penalties and
+        # weight-sync maps must never touch quantization scales
+        return {"W_q": "weight", "w_scale": "scale", "b": "bias"}

@@ -1,0 +1,273 @@
+"""Quantized variant builders — real bf16/int8 serving bundles.
+
+Both builders take a published serving bundle (``serving.json`` + serializer
+checkpoints — the artifact ``GanExperiment.publish_for_serving`` writes) and
+emit a NEW bundle of the same shape whose manifest declares ``precision``
+plus calibration provenance (``quant`` block). A quantized variant is just
+a bundle: the store, watcher, reloader, and mux registry adopt it through
+the machinery they already have, and the canary gate polices its quality
+loss at adoption exactly like any other candidate (docs/QUANT.md).
+
+- :func:`build_bf16_variant` — params cast to bfloat16 end-to-end (the
+  serializer's tagged-uint16 encoding round-trips them losslessly); the
+  serving engine reads ``precision: "bf16"`` and traces its AOT
+  executables under a bfloat16 compute scope, so the matmuls run on the
+  MXU's bf16 path with f32 accumulation. Resident param bytes halve.
+- :func:`build_int8_variant` — post-training quantization of the
+  discriminator-feature classifier: every dense vertex is rebuilt as a
+  :class:`~.layers.QuantDenseLayer` with per-output-channel symmetric
+  int8 weights and an activation scale calibrated on a fixed seeded probe
+  batch (the canary's batch when the caller passes it — same rows, same
+  determinism). The generator checkpoint is copied byte-identical: int8
+  PTQ is the classifier's trade, the sampler keeps its precision.
+
+Calibration is deterministic by construction: the same probe rows through
+the same float graph produce bit-identical activation maxima, hence
+bit-identical scales — asserted by tests/test_quant.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+#: symmetric int8 range: one sign bit + 7 magnitude bits, -128 excluded so
+#: the scale maps amax exactly onto ±127 (standard symmetric PTQ)
+_QMAX = 127.0
+
+#: floor for calibrated maxima — an all-zero activation (dead vertex)
+#: must not produce a zero scale (division by zero at quantize time)
+_AMAX_FLOOR = 1e-8
+
+#: the canary gate's probe defaults (deploy/canary.py) — the fallback
+#: calibration batch is drawn with the same seed and row count so a
+#: builder without the canary's real rows still calibrates on the same
+#: fixed seeded stream the gate probes with
+CALIBRATION_SEED = 666
+CALIBRATION_ROWS = 256
+
+
+def read_bundle_manifest(directory: str) -> dict:
+    with open(os.path.join(directory, "serving.json")) as fh:
+        return json.load(fh)
+
+
+def write_bundle_manifest(directory: str, manifest: dict) -> None:
+    """Temp + atomic-rename manifest write (the harness publish idiom) —
+    a watcher polling the directory can never observe a torn manifest."""
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(manifest, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, os.path.join(directory, "serving.json"))
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def default_calibration_rows(num_features: int,
+                             num_rows: int = CALIBRATION_ROWS,
+                             seed: int = CALIBRATION_SEED) -> np.ndarray:
+    """The fallback probe batch: seeded uniform rows in [0, 1) — the range
+    the reference pipeline scales real rows into. Callers holding the
+    canary's actual evaluation rows should pass those instead."""
+    rng = np.random.default_rng(seed)
+    return rng.random((num_rows, num_features), dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# int8 PTQ
+# ---------------------------------------------------------------------------
+
+def calibrate_activation_scales(graph, params, rows) -> Dict[str, float]:
+    """Per-dense-vertex activation scales off one forward pass of the
+    probe batch: for each dense vertex, the amax of its INPUT activation
+    (the producing vertex's output, through the consumer's preprocessor
+    when one exists — a reshape preserves amax, but exactness is free
+    here) mapped onto ±127. Deterministic: same rows, same params ⇒
+    bit-identical scales."""
+    import jax.numpy as jnp
+
+    from gan_deeplearning4j_tpu.nn.layers import DenseLayer
+
+    rows = jnp.asarray(np.asarray(rows, dtype=np.float32))
+    acts = graph.feed_forward(params, rows, train=False)
+    scales: Dict[str, float] = {}
+    for v in graph.vertices:
+        if v.layer is None or not isinstance(v.layer, DenseLayer):
+            continue
+        x = acts[v.inputs[0]]
+        if v.preprocessor is not None:
+            x = v.preprocessor(x)
+        amax = float(jnp.max(jnp.abs(x)))
+        scales[v.name] = max(amax, _AMAX_FLOOR) / _QMAX
+    return scales
+
+
+def quantize_dense_params(w, b, *, act_scale: float) -> Dict:
+    """Per-output-channel symmetric weight quantization: scale_j maps the
+    column's amax onto ±127, weights round-to-nearest into int8. Returns
+    the QuantDenseLayer param dict (b passes through as float)."""
+    import jax.numpy as jnp
+
+    w = jnp.asarray(w, dtype=jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(w), axis=0), _AMAX_FLOOR)
+    w_scale = (amax / _QMAX).astype(jnp.float32)
+    w_q = jnp.clip(jnp.round(w / w_scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return {"W_q": w_q, "w_scale": w_scale,
+            "b": jnp.asarray(b, dtype=jnp.float32)}
+
+
+def quantize_classifier(graph, params, rows):
+    """Graph surgery: every DenseLayer/OutputLayer vertex becomes a
+    QuantDenseLayer carrying its calibrated activation scale; every other
+    vertex (batchnorm, conv, activation) keeps its float form — standard
+    PTQ practice, and the measured cost block prices the result honestly
+    either way. Returns (quantized graph, quantized params, scales)."""
+    from gan_deeplearning4j_tpu.nn.graph import ComputationGraph
+    from gan_deeplearning4j_tpu.nn.layers import DenseLayer
+
+    # import registers QuantDenseLayer for the from_dict rebuild below
+    from gan_deeplearning4j_tpu.quant.layers import QuantDenseLayer  # noqa: F401
+
+    scales = calibrate_activation_scales(graph, params, rows)
+    spec = graph.to_dict()
+    for node in spec["nodes"]:
+        name = node["name"]
+        if name not in scales:
+            continue
+        layer_d = node["layer"]
+        node["layer"] = {
+            "type": "QuantDenseLayer",
+            "activation": layer_d.get("activation"),
+            "weight_init": layer_d.get("weight_init"),
+            "updater": layer_d.get("updater"),
+            "l2": layer_d.get("l2"),
+            "n_out": layer_d["n_out"],
+            "n_in": layer_d.get("n_in"),
+            "act_scale": scales[name],
+        }
+    qgraph = ComputationGraph.from_dict(spec)
+    qparams = dict(params)
+    for v in graph.vertices:
+        if v.name in scales and isinstance(v.layer, DenseLayer):
+            p = params[v.name]
+            qparams[v.name] = quantize_dense_params(
+                p["W"], p["b"], act_scale=scales[v.name])
+    return qgraph, qparams, scales
+
+
+# ---------------------------------------------------------------------------
+# bf16 cast
+# ---------------------------------------------------------------------------
+
+def cast_params_bf16(params):
+    """Float leaves → bfloat16 (the serializer stores them tagged-uint16);
+    integer leaves (none today in serving checkpoints) pass through."""
+    import jax
+    import jax.numpy as jnp
+
+    def _cast(leaf):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            return arr.astype(jnp.bfloat16)
+        return arr
+
+    return jax.tree_util.tree_map(_cast, params)
+
+
+# ---------------------------------------------------------------------------
+# bundle builders
+# ---------------------------------------------------------------------------
+
+def _base_quant_block(manifest: dict, source_dir: str, method: str) -> dict:
+    return {
+        "method": method,
+        "source": os.path.basename(os.path.abspath(source_dir)),
+        "source_generation": manifest.get("generation"),
+        "source_step": manifest.get("step"),
+        "built_unix": time.time(),
+    }
+
+
+def build_bf16_variant(source_dir: str, out_dir: str) -> dict:
+    """Source bundle → bf16 bundle: every checkpoint's params cast to
+    bfloat16, manifest gains ``precision: "bf16"`` + provenance. The
+    serving engine maps the precision to a bfloat16 compute scope at AOT
+    trace time (serving/engine.py), so storage AND matmul precision drop
+    together — resident bytes halve, and the MXU runs its native path.
+    Returns the written manifest."""
+    from gan_deeplearning4j_tpu.utils.serializer import read_model, write_model
+
+    manifest = read_bundle_manifest(source_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    for key in ("generator", "classifier"):
+        name = manifest.get(key)
+        if not name:
+            continue
+        graph, params, _, _ = read_model(
+            os.path.join(source_dir, name), load_updater=False)
+        write_model(os.path.join(out_dir, name), graph,
+                    cast_params_bf16(params), save_updater=False)
+    manifest["precision"] = "bf16"
+    manifest["quant"] = _base_quant_block(manifest, source_dir, "bf16_cast")
+    write_bundle_manifest(out_dir, manifest)
+    return manifest
+
+
+def build_int8_variant(source_dir: str, out_dir: str, *,
+                       calibration_rows: Optional[np.ndarray] = None,
+                       calibration_seed: int = CALIBRATION_SEED) -> dict:
+    """Source bundle → int8 bundle: the classifier's dense vertices are
+    post-training-quantized against ``calibration_rows`` (the canary's
+    probe batch when the caller has it; the seeded fallback stream
+    otherwise), the generator checkpoint is copied byte-identical, and
+    the manifest gains ``precision: "int8"`` + full calibration
+    provenance (seed, row count, per-vertex scales). Returns the written
+    manifest."""
+    from gan_deeplearning4j_tpu.utils.serializer import read_model, write_model
+
+    manifest = read_bundle_manifest(source_dir)
+    cv_name = manifest.get("classifier")
+    if not cv_name:
+        raise ValueError(
+            f"bundle at {source_dir} serves no classifier — int8 PTQ "
+            f"quantizes the discriminator-feature classifier")
+    os.makedirs(out_dir, exist_ok=True)
+
+    graph, params, _, _ = read_model(
+        os.path.join(source_dir, cv_name), load_updater=False)
+    caller_rows = calibration_rows is not None
+    if calibration_rows is None:
+        calibration_rows = default_calibration_rows(
+            graph.input_types[0].features, seed=calibration_seed)
+    rows = np.asarray(calibration_rows, dtype=np.float32)
+    qgraph, qparams, scales = quantize_classifier(graph, params, rows)
+    write_model(os.path.join(out_dir, cv_name), qgraph, qparams,
+                save_updater=False)
+
+    gen_name = manifest.get("generator")
+    if gen_name:
+        shutil.copyfile(os.path.join(source_dir, gen_name),
+                        os.path.join(out_dir, gen_name))
+
+    manifest["precision"] = "int8"
+    quant = _base_quant_block(manifest, source_dir,
+                              "ptq_per_channel_symmetric")
+    quant["calibration"] = {
+        "seed": int(calibration_seed),
+        "num_rows": int(rows.shape[0]),
+        "source": "caller_probe_batch" if caller_rows else "seeded_fallback",
+        "activation_scales": {k: float(v) for k, v in sorted(scales.items())},
+    }
+    manifest["quant"] = quant
+    write_bundle_manifest(out_dir, manifest)
+    return manifest

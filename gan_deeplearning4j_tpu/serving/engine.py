@@ -123,8 +123,11 @@ class ServingEngine:
         generation: Optional[int] = None,
         export_gauge: bool = True,
         staging_pool=None,
+        precision: Optional[str] = None,
     ):
         import jax
+
+        from gan_deeplearning4j_tpu.runtime.dtype import parse_compute_dtype
 
         if not models:
             raise ValueError("ServingEngine needs at least one model")
@@ -132,6 +135,14 @@ class ServingEngine:
         #: loads) — the version the reload plane keys on; /healthz and
         #: /metrics surface it so an operator can see WHICH model serves
         self.generation = generation
+        #: the bundle manifest's declared precision ("bf16"/"int8"/None =
+        #: fp32; docs/QUANT.md). bf16 additionally selects the compute
+        #: dtype the AOT executables are traced under, so storage and
+        #: matmul precision drop together; int8 needs no compute scope —
+        #: the quantized layers carry their own dtypes in the graph.
+        self.precision = precision
+        self._compute_dtype = (parse_compute_dtype("bf16")
+                               if precision == "bf16" else None)
         buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"invalid bucket ladder {buckets!r}")
@@ -263,6 +274,7 @@ class ServingEngine:
         generation: Optional[int] = None,
         export_gauge: bool = True,
         staging_pool=None,
+        precision: Optional[str] = None,
     ) -> "ServingEngine":
         """Restore from serializer checkpoint zips. Updater state is never
         loaded — a serving replica has no optimizer."""
@@ -278,7 +290,8 @@ class ServingEngine:
                 models[role] = (graph, params)
         return cls(models, buckets=buckets, feature_vertex=feature_vertex,
                    replicas=replicas, generation=generation,
-                   export_gauge=export_gauge, staging_pool=staging_pool)
+                   export_gauge=export_gauge, staging_pool=staging_pool,
+                   precision=precision)
 
     @classmethod
     def from_bundle(
@@ -309,6 +322,7 @@ class ServingEngine:
             generation=manifest.get("generation"),
             export_gauge=export_gauge,
             staging_pool=staging_pool,
+            precision=manifest.get("precision"),
         )
 
     # -- introspection ------------------------------------------------------
@@ -338,6 +352,25 @@ class ServingEngine:
     @property
     def replica_count(self) -> int:
         return len(self._devices)
+
+    @property
+    def platform(self) -> str:
+        """The device platform the ladder is compiled for ("cpu"/"tpu")."""
+        return self._devices[0].platform
+
+    def resident_param_bytes(self) -> int:
+        """Device bytes ONE replica of this engine's parameters pins —
+        the residency denominator the measured cost block records
+        (quant/cost.py): bf16 params halve it, int8 weights quarter it.
+        Staging buffers and executables are accounted separately (the
+        shared pool's ``stats()`` and the compile ledger)."""
+        import jax
+
+        return sum(
+            leaf.nbytes
+            for replicas in self._params.values()
+            for leaf in jax.tree_util.tree_leaves(replicas[0])
+        )
 
     @property
     def default_pipeline_depth(self) -> int:
@@ -398,6 +431,7 @@ class ServingEngine:
             return {
                 "replicas": len(self._devices),
                 "generation": self.generation,
+                "precision": self.precision or "fp32",
                 "replica_dispatches": list(self._dispatches),
                 "replica_in_flight": list(self._outstanding),
                 "compile_counts": dict(self._compile_counts),
@@ -431,6 +465,10 @@ class ServingEngine:
             import jax
             from jax.sharding import SingleDeviceSharding
 
+            from gan_deeplearning4j_tpu.runtime.dtype import (
+                compute_dtype_scope,
+            )
+
             role, fn = self._kinds[kind]
             spec = jax.ShapeDtypeStruct(
                 (bucket, self._in_width[kind]), np.float32,
@@ -438,9 +476,13 @@ class ServingEngine:
             )
             # AOT: lower for the exact padded shape on the exact replica
             # device and keep the executable; serve-time calls can then
-            # never re-trace or re-compile
+            # never re-trace or re-compile. The compute-dtype scope is
+            # active during tracing only — a bf16 bundle's casts are
+            # baked INTO the executable, not toggled per request (and
+            # fp32 engines pin None so ambient state never leaks in).
             with TRACER.span("serve.engine.compile", kind=kind,
-                             bucket=bucket, replica=replica):
+                             bucket=bucket, replica=replica), \
+                    compute_dtype_scope(self._compute_dtype):
                 exe = jax.jit(fn).lower(
                     self._params[role][replica], spec
                 ).compile()
@@ -472,6 +514,10 @@ class ServingEngine:
             import jax
             from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+            from gan_deeplearning4j_tpu.runtime.dtype import (
+                compute_dtype_scope,
+            )
+
             mesh = Mesh(np.asarray(self._devices), ("replica",))
             replicated = NamedSharding(mesh, PartitionSpec())
             batched = NamedSharding(mesh, PartitionSpec("replica"))
@@ -486,7 +532,8 @@ class ServingEngine:
                 (slab, self._in_width[kind]), np.float32, sharding=batched
             )
             with TRACER.span("serve.engine.compile", kind=kind,
-                             bucket=slab, replica="bulk"):
+                             bucket=slab, replica="bulk"), \
+                    compute_dtype_scope(self._compute_dtype):
                 exe = jax.jit(fn).lower(
                     self._params_mesh[role], spec
                 ).compile()

@@ -112,17 +112,26 @@ class SharedStagingPool:
 
 class MuxVariant:
     """One named serving variant: a cold manifest always, an engine +
-    batcher only while resident. Mutated ONLY under the registry lock."""
+    batcher only while resident. Mutated ONLY under the registry lock.
 
-    __slots__ = ("name", "bundle_path", "cost", "generation", "state",
-                 "engine", "batcher", "last_error", "added_at",
-                 "warmed_at")
+    ``cost`` — the number eviction and brownout rank by — prefers the
+    MEASURED scalar (a ``quant/cost.py`` block: residency-rent
+    GiB·s/kilorow profiled on the live ladder) and falls back to the
+    operator-declared bootstrap value until one lands. ``cost_source``
+    names which of the two is live (``measured``/``declared``) so
+    dashboards can tell economics from guesswork."""
+
+    __slots__ = ("name", "bundle_path", "declared_cost", "measured",
+                 "generation", "state", "engine", "batcher", "last_error",
+                 "added_at", "warmed_at")
 
     def __init__(self, name: str, *, bundle_path: Optional[str],
                  cost: float, generation):
         self.name = name
         self.bundle_path = bundle_path
-        self.cost = float(cost)
+        self.declared_cost = float(cost)
+        #: measured cost block (quant/cost.py schema) or None (bootstrap)
+        self.measured: Optional[dict] = None
         self.generation = generation
         self.state = "cold"
         self.engine = None
@@ -131,12 +140,44 @@ class MuxVariant:
         self.added_at = time.time()
         self.warmed_at: Optional[float] = None
 
+    @property
+    def cost(self) -> float:
+        if self.measured is not None:
+            return float(self.measured["scalar"])
+        return self.declared_cost
+
+    @property
+    def cost_source(self) -> str:
+        return "measured" if self.measured is not None else "declared"
+
+    def set_measured(self, block: Optional[dict]) -> None:
+        """Adopt (or clear, with None) a measured cost block. The block
+        must carry a positive ``scalar`` — a zero/negative measurement
+        would silently game shed ordering."""
+        if block is not None:
+            scalar = block.get("scalar")
+            if not isinstance(scalar, (int, float)) or scalar <= 0:
+                raise ValueError(
+                    f"measured cost block for {self.name!r} needs a "
+                    f"positive 'scalar', got {scalar!r}")
+        self.measured = dict(block) if block is not None else None
+
     def snapshot(self, weight: float) -> dict:
         engine = self.engine
+        measured = self.measured
         return {
             "name": self.name,
             "state": self.state,
             "cost": self.cost,
+            "cost_source": self.cost_source,
+            "declared_cost": self.declared_cost,
+            "measured_cost": (
+                None if measured is None else float(measured["scalar"])),
+            "resident_param_bytes": (
+                None if measured is None
+                else measured.get("resident_param_bytes")),
+            "precision": (
+                None if measured is None else measured.get("precision")),
             "weight": weight,
             "generation": self.generation,
             "bundle_path": self.bundle_path,
@@ -200,6 +241,19 @@ class MuxRegistry:
             "mux_route_fallbacks_total",
             "requests whose assigned variant was not resident and fell "
             "back to the resident pool (residency-budget misses)")
+        self._g_cost = registry.gauge(
+            "mux_variant_cost",
+            "the cost eviction/brownout rank by (measured scalar when "
+            "one landed, declared bootstrap otherwise)",
+            labelnames=("model",))
+        self._g_cost_source = registry.gauge(
+            "mux_variant_cost_source",
+            "1 = cost is a live-ladder measurement (quant/cost.py), "
+            "0 = operator-declared bootstrap", labelnames=("model",))
+        self._g_resident_bytes = registry.gauge(
+            "mux_variant_resident_param_bytes",
+            "measured device bytes one replica of the variant's params "
+            "pins (0 until measured)", labelnames=("model",))
 
     # -- builds (the PR 7 reloader path, shared-pool edition) -------------
     def build_engine(self, bundle_path: str):
@@ -238,9 +292,12 @@ class MuxRegistry:
         """Register a variant. With ``engine`` (already built + warmed —
         the adopt path) it becomes resident immediately; with only a
         ``bundle_path`` it stays a cold manifest until its weight asks
-        for residency. ``cost`` is the relative serve cost (bf16 sibling
-        < fp32 original) the per-model brownout sheds by — highest cost
-        sheds first (docs/MULTIPLEX.md)."""
+        for residency. ``cost`` is the DECLARED relative serve cost (bf16
+        sibling < fp32 original) — a bootstrap default: when the bundle's
+        manifest carries a measured ``cost`` block (quant/cost.py), the
+        measurement is adopted immediately and eviction + brownout rank
+        by it instead — highest cost sheds first (docs/MULTIPLEX.md,
+        docs/QUANT.md)."""
         if bundle_path is None and engine is None:
             raise ValueError("a variant needs a bundle_path or an engine")
         if cost <= 0:
@@ -250,6 +307,12 @@ class MuxRegistry:
             generation = engine.generation
         variant = MuxVariant(name, bundle_path=bundle_path, cost=cost,
                              generation=generation)
+        if bundle_path is not None:
+            from gan_deeplearning4j_tpu.quant.cost import manifest_cost
+
+            block = manifest_cost(bundle_path)
+            if block is not None:
+                variant.set_measured(block)
         with self.lock:
             if name in self._variants:
                 raise ValueError(f"variant {name!r} already registered")
@@ -258,6 +321,7 @@ class MuxRegistry:
                 self._attach_locked(variant, engine)
         self.splitter.set_weight(name, weight)
         self._g_weight.labels(model=name).set(float(weight))
+        self._export_cost_gauges(variant)
         if engine is not None:
             self._enforce_budget(protect=name)
         elif weight > 0.0:
@@ -381,6 +445,32 @@ class MuxRegistry:
             self._c_evictions.labels(model=victim_name).inc()
             self.demote(victim_name)
 
+    # -- measured cost ------------------------------------------------------
+    def _export_cost_gauges(self, variant: MuxVariant) -> None:
+        measured = variant.measured
+        self._g_cost.labels(model=variant.name).set(variant.cost)
+        self._g_cost_source.labels(model=variant.name).set(
+            1.0 if measured is not None else 0.0)
+        self._g_resident_bytes.labels(model=variant.name).set(
+            float(measured.get("resident_param_bytes") or 0)
+            if measured is not None else 0.0)
+
+    def set_measured_cost(self, name: str, block: dict) -> None:
+        """Land a live-ladder measurement (quant/cost.py block) on a
+        registered variant: ``cost`` flips from the declared bootstrap to
+        the measured scalar, and every ranking that reads ``costs()`` —
+        residency eviction, brownout shed order — follows on its next
+        decision. Recorded in the event log (drills assert on it)."""
+        with self.lock:
+            variant = self._variants[name]
+            variant.set_measured(block)
+            self.events.append({
+                "event": "cost_measured", "variant": name,
+                "scalar": variant.cost,
+                "resident_param_bytes": block.get("resident_param_bytes"),
+            })
+        self._export_cost_gauges(variant)
+
     # -- weights ----------------------------------------------------------
     def set_weight(self, name: str, weight: float,
                    warm: bool = True) -> None:
@@ -500,6 +590,12 @@ class MuxRegistry:
     def costs(self) -> Dict[str, float]:
         with self.lock:
             return {n: v.cost for n, v in self._variants.items()}
+
+    def cost_sources(self) -> Dict[str, str]:
+        """Per-variant provenance of the ranking number —
+        ``measured`` (live-ladder block) or ``declared`` (bootstrap)."""
+        with self.lock:
+            return {n: v.cost_source for n, v in self._variants.items()}
 
     def snapshot(self) -> dict:
         weights = self.splitter.weights()

@@ -347,6 +347,20 @@ class MuxService:
             "shares": snap["shares"],
             "brownout": {"active": level > 0, "level": level,
                          "shedding": sorted(self._shed_set())},
+            # the economics the shed/evict order runs on, with provenance:
+            # "measured" = live-ladder quant/cost.py block, "declared" =
+            # operator bootstrap (docs/QUANT.md)
+            "costs": {
+                name: {
+                    "cost": v["cost"],
+                    "cost_source": v["cost_source"],
+                    "declared_cost": v["declared_cost"],
+                    "measured_cost": v["measured_cost"],
+                    "resident_param_bytes": v["resident_param_bytes"],
+                    "precision": v["precision"],
+                }
+                for name, v in sorted(snap["variants"].items())
+            },
             "ramp": None if ramp is None else ramp.snapshot(),
             "slo": {name: tracker.snapshot()
                     for name, tracker in self._trackers_snapshot()},
@@ -385,6 +399,8 @@ class MuxService:
             "mux": {
                 "registry": self.registry.snapshot(),
                 "per_variant": per_variant,
+                "costs": self.registry.costs(),
+                "cost_sources": self.registry.cost_sources(),
                 "ramp": (None if self.ramp is None
                          else self.ramp.snapshot()),
             },

@@ -129,6 +129,22 @@ SPEC = {
         Metric("false_fires", "results.false_fires", "lower", 0.0,
                max_abs=0),
     ],
+    "quant": [
+        # resident-bytes frontier: bf16 must stay ~halved (a creeping
+        # ratio means float leaves stopped being cast), int8 below 1
+        Metric("bf16_bytes_ratio", "results.bf16.bytes_ratio", "lower",
+               0.10, max_abs=0.6),
+        Metric("int8_bytes_ratio", "results.int8.bytes_ratio", "lower",
+               0.10, max_abs=0.999),
+        # the scalar the mux actually ranks by — track, don't gate (CPU
+        # latency noise moves it); the per-record invariants gate < 1
+        Metric("bf16_cost_ratio", "results.bf16.cost_ratio", "info"),
+        Metric("int8_cost_ratio", "results.int8.cost_ratio", "info"),
+        # the admission gate itself: a quant build the canary rejects
+        # must fail the campaign, not ship as a ledger row
+        Metric("canary_failures", "results.canary_failures", "lower",
+               0.0, max_abs=0),
+    ],
     "train": [],  # raw bench dumps: invariants/ok gating only
 }
 

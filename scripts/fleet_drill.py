@@ -1113,11 +1113,32 @@ def run_mux(args) -> int:
             batcher_kwargs={"max_latency": 0.002, "max_queue": 12,
                             "default_timeout": 5.0})
         # the cost gradient the brownout sheds by: "heavy" is the
-        # expensive fp32 primary, "lite" the cheap sibling
+        # expensive fp32 primary, "lite" a REAL bf16 sibling built by
+        # the quant plane (docs/QUANT.md) — half the resident bytes, so
+        # the MEASURED cost ordering is deterministic even on a noisy
+        # CPU host. "lite" lands pre-measured through the manifest
+        # adoption path: measure_bundle_cost writes the cost block and
+        # registry.add() picks it up, flipping cost_source to
+        # "measured" without any drill-side plumbing.
+        from gan_deeplearning4j_tpu.quant import (
+            build_bf16_variant,
+            measure_bundle_cost,
+            measure_engine_cost,
+        )
+
+        lite_dir = os.path.join(workdir, "variant_bf16")
+        build_bf16_variant(bundles[1][1], lite_dir)
+        measure_bundle_cost(lite_dir, buckets=(1, 8), rounds=2)
         registry.add("heavy", bundle_path=bundles[0][1], cost=4.0,
                      weight=0.9, generation=bundles[0][0])
-        registry.add("lite", bundle_path=bundles[1][1], cost=1.0,
+        registry.add("lite", bundle_path=lite_dir, cost=1.0,
                      weight=0.1, generation=bundles[1][0])
+        # the manifest-adoption path worked before any drill-side
+        # plumbing ran: lite entered already measured, heavy (a store
+        # bundle, no cost block) on its declared bootstrap
+        invariants["manifest_cost_block_adopted"] = (
+            registry.cost_sources() == {"heavy": "declared",
+                                        "lite": "measured"})
         svc = MuxService(
             registry,
             slo_config=SLOConfig(
@@ -1135,8 +1156,54 @@ def run_mux(args) -> int:
         invariants["boots_ok"] = health.get("status") == "ok"
         invariants["shared_pool_attached"] = (
             registry.engine_for("heavy")._shared_staging is registry.pool)
+        # the measurement the ordering invariants run on is PAIRED:
+        # both live engines profiled back to back, interleaved, and the
+        # min-per_row block kept per engine — an unpaired measurement
+        # (lite timed cold at build, heavy timed later under different
+        # host load) lets one slow sample flip the ranking on a model
+        # this small. This also exercises the second adoption route:
+        # set_measured_cost landing a live profile on a registered
+        # variant (heavy flips declared -> measured here).
+        heavy_cost = lite_cost = None
+        for _ in range(3):
+            hb = measure_engine_cost(registry.engine_for("heavy"),
+                                     rounds=2)
+            lb = measure_engine_cost(registry.engine_for("lite"),
+                                     rounds=2)
+            if heavy_cost is None or hb["per_row_s"] < heavy_cost[
+                    "per_row_s"]:
+                heavy_cost = hb
+            if lite_cost is None or lb["per_row_s"] < lite_cost[
+                    "per_row_s"]:
+                lite_cost = lb
+        registry.set_measured_cost("heavy", heavy_cost)
+        registry.set_measured_cost("lite", lite_cost)
+        costs = registry.costs()
+        sources = registry.cost_sources()
+        results["measured_costs"] = {
+            "scalars": costs,
+            "sources": sources,
+            "resident_param_bytes": {
+                "heavy": heavy_cost["resident_param_bytes"],
+                "lite": lite_cost["resident_param_bytes"],
+            },
+        }
+        invariants["costs_measured_not_declared"] = (
+            sources.get("heavy") == "measured"
+            and sources.get("lite") == "measured")
+        # the quant claim, measured on this host: the bf16 sibling pins
+        # half the bytes and its residency-rent scalar ranks below fp32
+        invariants["bf16_variant_genuinely_cheaper"] = (
+            lite_cost["resident_param_bytes"]
+            < heavy_cost["resident_param_bytes"]
+            and costs["lite"] < costs["heavy"])
+        health = fleet_health(base)
+        invariants["status_reports_cost_source"] = all(
+            health.get("costs", {}).get(n, {}).get("cost_source")
+            == "measured" for n in ("heavy", "lite"))
         log(f"mux service up at {base}: "
-            f"variants {sorted(registry.names())}")
+            f"variants {sorted(registry.names())}, measured costs "
+            f"{ {n: f'{c:.3g}' for n, c in costs.items()} }")
 
         # -- phase 1: 10/90 split under closed-loop load ------------------
         load = LoadGenerator(base, z_size, threads=4, pace=0.004)
@@ -1162,6 +1229,11 @@ def run_mux(args) -> int:
         # -- phase 2: ramp with one injected SLO burn → auto-rollback -----
         registry.add("cand", bundle_path=bundles[2][1], cost=1.0,
                      weight=0.0, generation=bundles[2][0])
+        # the candidate's store bundle carries no cost block: it enters
+        # on its declared bootstrap, coexisting with measured peers —
+        # the bootstrap-default contract (docs/QUANT.md)
+        invariants["declared_bootstrap_coexists"] = (
+            registry.cost_sources().get("cand") == "declared")
         # generous holds: the injection below must land while the ramp
         # is still mid-ladder, not race a sprinting one
         ramp = svc.start_ramp("cand", stages=(0.01, 0.10, 0.50, 1.0),
@@ -1265,6 +1337,16 @@ def run_mux(args) -> int:
         invariants["brownout_engages_under_overload"] = bool(engaged)
         invariants["brownout_sheds_expensive_first"] = (
             heavy_sheds > 0 and lite_sheds == 0)
+        # and that order came from the MEASUREMENT: both shed-ranked
+        # variants carry measured scalars and the one that shed ranks
+        # above the one that served — not the 4.0-vs-1.0 declaration
+        mid_costs = registry.costs()
+        mid_sources = registry.cost_sources()
+        invariants["shed_order_follows_measured_cost"] = (
+            mid_sources.get("heavy") == "measured"
+            and mid_sources.get("lite") == "measured"
+            and mid_costs["heavy"] > mid_costs["lite"]
+            and heavy_sheds > 0 and lite_sheds == 0)
         invariants["cheap_variant_serves_through_brownout"] = (
             lite_ok_during > 0)
         released = wait_for(lambda: svc.brownout_level == 0, 30.0,

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""quant_bench — paired fp32 / bf16 / int8 serving economics, measured.
+
+The quant plane's claim (docs/QUANT.md) is that a quantized variant is
+*cheaper where the mux economics look*: fewer resident param bytes, no
+worse per-row latency, and a smaller measured cost scalar — while the
+canary gate confirms the quality loss stays inside the same relative
+thresholds any reload candidate must clear. This bench measures all of
+it in one process against one freshly published bundle:
+
+1. **publish** — a tiny seeded MNIST-family experiment publishes its
+   fp32 serving bundle (generator + dis-feature classifier, the paper's
+   end product);
+2. **build** — ``quant/variants.py`` derives the bf16 and int8 siblings
+   from that bundle (same calibration seed every run);
+3. **measure** — each variant's engine is profiled on the same bucket
+   ladder (``quant/cost.py``): per-bucket min-of-rounds latency,
+   resident param bytes, the cost scalar; blocks land in each bundle's
+   manifest, exactly as a campaign would leave them for the mux;
+4. **A/B** — paired alternating-round latency at the top bucket, fp32
+   vs each variant per request kind (alternation cancels slow host
+   drift the way serve_bench's ``--compare`` does);
+5. **drift + canary** — max output deviation per kind on fixed seeded
+   inputs, then the real CanaryGate evaluates each variant against the
+   fp32 incumbent on labeled synthetic rows: a variant this bench
+   ships numbers for is one the reload plane would actually admit.
+
+Gating: ``scripts/bench_ledger.py`` tracks the recorded
+``BENCH_quant_<round>.json`` under its ``quant`` family — bytes ratios
+must stay below 1 and canary failures at 0, or the campaign fails.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/quant_bench.py --smoke
+    python scripts/quant_bench.py --record r01   # BENCH_quant_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _publish_fp32(workdir: str, seed: int) -> str:
+    from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
+    from gan_deeplearning4j_tpu.harness.experiment import GanExperiment
+
+    cfg = ExperimentConfig(
+        batch_size_train=8, batch_size_pred=8, num_iterations=1,
+        latent_grid=2, save_models=False, seed=seed,
+        output_dir=os.path.join(workdir, "train_out"),
+    )
+    exp = GanExperiment(cfg)
+    bundle = os.path.join(workdir, "fp32")
+    exp.publish_for_serving(bundle)
+    return bundle
+
+
+def _paired_ab(base, other, *, rounds: int) -> dict:
+    """Alternating-round min latency per kind at the top bucket: the
+    variant's share of the fp32 time (< 1 means faster). Alternation
+    keeps both sides exposed to the same host noise."""
+    out = {}
+    top = max(base.buckets)
+    for kind in base.kinds:
+        width = base.input_width(kind)
+        rows = np.zeros((top, width), np.float32)
+        best_base = best_other = float("inf")
+        for _ in range(max(1, rounds)):
+            t0 = time.perf_counter()
+            base.run(kind, rows)
+            best_base = min(best_base, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            other.run(kind, rows)
+            best_other = min(best_other, time.perf_counter() - t0)
+        out[kind] = {
+            "fp32_s": best_base,
+            "variant_s": best_other,
+            "ratio": best_other / best_base if best_base > 0 else None,
+        }
+    return out
+
+
+def _output_drift(base, other, *, seed: int) -> dict:
+    out = {}
+    for kind in base.kinds:
+        width = base.input_width(kind)
+        rows = np.random.default_rng(seed).random(
+            (8, width)).astype(np.float32)
+        a = np.asarray(base.run(kind, rows), np.float32)
+        b = np.asarray(other.run(kind, rows), np.float32)
+        out[kind] = float(np.max(np.abs(a - b)))
+    return out
+
+
+def run_bench(args) -> dict:
+    from gan_deeplearning4j_tpu.data.mnist import synthetic_mnist
+    from gan_deeplearning4j_tpu.deploy.canary import CanaryGate
+    from gan_deeplearning4j_tpu.quant import (
+        build_bf16_variant,
+        build_int8_variant,
+        measure_engine_cost,
+        write_cost_block,
+    )
+    from gan_deeplearning4j_tpu.serving.engine import ServingEngine
+
+    workdir = tempfile.mkdtemp(prefix="quant_bench_")
+    try:
+        t0 = time.time()
+        fp32_dir = _publish_fp32(workdir, args.seed)
+        dirs = {"fp32": fp32_dir,
+                "bf16": os.path.join(workdir, "bf16"),
+                "int8": os.path.join(workdir, "int8")}
+        build_bf16_variant(fp32_dir, dirs["bf16"])
+        build_int8_variant(fp32_dir, dirs["int8"])
+
+        engines = {}
+        costs = {}
+        for name, d in dirs.items():
+            engine = ServingEngine.from_bundle(
+                d, buckets=args.buckets, export_gauge=False)
+            engine.warmup()
+            engines[name] = engine
+            block = measure_engine_cost(engine, rounds=args.rounds)
+            write_cost_block(d, block)
+            costs[name] = block
+
+        fp32 = engines["fp32"]
+        variants = {}
+        for name in ("bf16", "int8"):
+            block = costs[name]
+            variants[name] = {
+                "resident_param_bytes": block["resident_param_bytes"],
+                "bytes_ratio": (block["resident_param_bytes"]
+                                / costs["fp32"]["resident_param_bytes"]),
+                "cost_scalar": block["scalar"],
+                "cost_ratio": block["scalar"] / costs["fp32"]["scalar"],
+                "ab_latency": _paired_ab(fp32, engines[name],
+                                         rounds=args.rounds),
+                "output_drift": _output_drift(fp32, engines[name],
+                                              seed=args.seed),
+            }
+
+        # the real admission gate, against the fp32 incumbent
+        (rows, labels), _ = synthetic_mnist(
+            num_train=args.canary_rows, num_test=1, seed=args.seed)
+        gate = CanaryGate(rows, labels, num_samples=args.canary_samples,
+                          seed=args.seed)
+        canary = {}
+        for name in ("bf16", "int8"):
+            decision = gate.evaluate(engines[name], fp32)
+            canary[name] = {"passed": decision.passed,
+                            "reason": decision.reason,
+                            "candidate": decision.candidate,
+                            "incumbent": decision.incumbent}
+            # next variant gates against the same fp32 incumbent
+            gate._incumbent_cache = None
+        failures = sum(1 for c in canary.values() if not c["passed"])
+
+        results = {
+            "fp32": {
+                "resident_param_bytes": costs["fp32"][
+                    "resident_param_bytes"],
+                "cost_scalar": costs["fp32"]["scalar"],
+                "per_row_s": costs["fp32"]["per_row_s"],
+            },
+            "bf16": variants["bf16"],
+            "int8": variants["int8"],
+            "canary": canary,
+            "canary_failures": failures,
+            "wall_s": time.time() - t0,
+        }
+        invariants = {
+            # the residency halving bf16 exists for (exact: every float
+            # leaf 4 -> 2 bytes), with slack for non-float metadata
+            "bf16_bytes_halved": variants["bf16"]["bytes_ratio"] <= 0.6,
+            # int8 shrinks only the classifier's dense vertices — any
+            # real shrink counts, the exact ratio is model-shaped
+            "int8_bytes_shrunk": variants["int8"]["bytes_ratio"] < 1.0,
+            # cheaper where the mux ranks: bf16's measured scalar must
+            # drop (the bytes factor halves exactly, dwarfing latency
+            # noise). int8's is deliberately NOT gated: on hosts without
+            # an int8 matmul path (CPU) the quant/dequant overhead can
+            # price it above fp32 — and the measured plane's whole point
+            # is that the mux then ranks it accordingly instead of
+            # trusting a declared "int8 is cheap" fiction; the ledger
+            # tracks the ratio as info either way
+            "bf16_cost_cheaper": variants["bf16"]["cost_ratio"] < 1.0,
+            "canary_admits_both": failures == 0,
+        }
+        return {
+            "bench": "quant",
+            "config": {
+                "rounds": args.rounds,
+                "buckets": list(args.buckets),
+                "seed": args.seed,
+                "smoke": bool(args.smoke),
+                "platform": fp32.platform,
+            },
+            "results": results,
+            "invariants": invariants,
+            "ok": all(invariants.values()),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--rounds", type=int, default=5,
+                   help="timing rounds per (kind, bucket), min-of-rounds")
+    p.add_argument("--buckets", default="1,8,32",
+                   type=lambda s: tuple(int(b) for b in s.split(",")))
+    p.add_argument("--canary-rows", type=int, default=64)
+    p.add_argument("--canary-samples", type=int, default=32)
+    p.add_argument("--seed", type=int, default=666)
+    p.add_argument("--smoke", action="store_true",
+                   help="small fixed shape for CI/campaign gating")
+    p.add_argument("--record", default=None, metavar="TAG",
+                   help="also write BENCH_quant_<TAG>.json at the repo root")
+    p.add_argument("--output",
+                   default=os.path.join(_REPO, "artifacts",
+                                        "quant_bench.json"))
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        args.rounds = min(args.rounds, 2)
+        args.buckets = (1, 8)
+        args.canary_rows = min(args.canary_rows, 48)
+        args.canary_samples = min(args.canary_samples, 16)
+
+    summary = run_bench(args)
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                exist_ok=True)
+    with open(args.output, "w") as fh:
+        json.dump(summary, fh, indent=2)
+        fh.write("\n")
+    if args.record:
+        with open(os.path.join(_REPO,
+                               f"BENCH_quant_{args.record}.json"),
+                  "w") as fh:
+            json.dump(summary, fh, indent=2)
+            fh.write("\n")
+    sys.stdout.write(json.dumps(summary["results"], indent=2) + "\n")
+    bad = [k for k, v in summary["invariants"].items() if not v]
+    if bad:
+        sys.stderr.write(f"quant_bench: invariants violated: {bad}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,6 +171,20 @@ if ! JAX_PLATFORMS=cpu timeout 1500 python scripts/fleet_drill.py --smoke \
   echo "$(date +%H:%M:%S) fleet alerts smoke failed — campaign aborted (see fleet_alerts_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Quant smoke (CPU, bf16/int8 variant builders + measured cost,
+# docs/QUANT.md): the campaign's mux economics rank by MEASURED cost —
+# refuse to start if the variant builders, the cost profiler, or the
+# canary admission of a quantized sibling regressed: bf16 resident
+# bytes halved, int8 classifier shrunk, bf16 measured scalar below
+# fp32, both variants admitted by the real canary gate (enforced by
+# the bench's own exit code). Pinned to CPU so it never touches the
+# chip; the artifact lands next to the SARIF/lifecycle census so every
+# campaign ships the quant economics it ran under.
+if ! JAX_PLATFORMS=cpu timeout 900 python scripts/quant_bench.py --smoke \
+    --output artifacts/quant_bench_smoke.json > quant_bench_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) quant bench smoke failed — campaign aborted (see quant_bench_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 bench_done=0
 ceiling_done=0
 tune_done=0

@@ -3922,6 +3922,73 @@ class TestHandoffWithoutTransfer:
 
 
 # ===========================================================================
+# JG030 — quantized-variant precision/cast mismatch
+# ===========================================================================
+
+class TestQuantPrecisionCastMismatch:
+    def test_true_positive_declares_bf16_casts_fp16(self):
+        # two incompatible 16-bit formats: the manifest promises bf16 (the
+        # engine compiles a bfloat16 scope) but the bytes are fp16
+        r = run(
+            "import jax.numpy as jnp\n"
+            "def build_variant(params, manifest):\n"
+            "    casted = params.astype(jnp.float16)\n"
+            "    manifest['precision'] = 'bf16'\n"
+            "    return casted, manifest\n"
+        )
+        assert codes(r) == ["JG030"]
+        assert "fp16" in r.active[0].message
+
+    def test_true_positive_int8_kwarg_with_uint8_dtype(self):
+        # declared through a precision= kwarg, contradicted by a dtype=
+        # kwarg: uint8 weights under an int8 QuantDenseLayer contract
+        r = run(
+            "import numpy as np\n"
+            "def publish(store, w):\n"
+            "    q = np.asarray(w, dtype=np.uint8)\n"
+            "    store.put(q, precision='int8')\n"
+        )
+        assert codes(r) == ["JG030"]
+
+    def test_true_negative_matching_cast(self):
+        # the correct builder: declared bf16, cast bf16 — extra f32
+        # upcasts alongside (dequant outputs) never count against it
+        r = run(
+            "import jax.numpy as jnp\n"
+            "def build_variant(params):\n"
+            "    casted = params.astype(jnp.bfloat16)\n"
+            "    scale = params.astype(jnp.float32)\n"
+            "    return {'precision': 'bf16', 'p': casted, 's': scale}\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_declaration_without_casts(self):
+        # byte-identical copy path (the int8 generator): a declaration
+        # with no low-precision cast in scope is not evidence of anything
+        r = run(
+            "import shutil\n"
+            "def copy_variant(src, dst, manifest):\n"
+            "    shutil.copyfile(src, dst)\n"
+            "    manifest['precision'] = 'int8'\n"
+            "    return manifest\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_dispatch_table_both_precisions(self):
+        # a scope naming BOTH precisions is a dispatch table, not a
+        # single-variant builder — nothing to contradict
+        r = run(
+            "import jax.numpy as jnp\n"
+            "def pick(kind, params):\n"
+            "    table = {'precision': 'bf16'}\n"
+            "    other = {'precision': 'int8'}\n"
+            "    casted = params.astype(jnp.float16)\n"
+            "    return table, other, casted\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
 # JG025 cross-class unification (satellite on the concurrency index)
 # ===========================================================================
 

@@ -1,0 +1,455 @@
+"""quant/ — real quantized variants with measured cost (docs/QUANT.md).
+
+What must hold:
+
+- **calibration is deterministic**: same probe rows, same float params →
+  bit-identical activation scales, across independent builds;
+- **outputs stay close**: a bf16 or int8 variant serves within tight
+  tolerance of its fp32 source on every request kind (and the int8
+  generator is byte-identical — PTQ is the classifier's trade);
+- **a quantized bundle is just a bundle**: serializer round-trip
+  preserves int8 params exactly, the engine serves it through
+  ``from_bundle``, and ``QuantDenseLayer`` resolves lazily in a process
+  that never imported quant/;
+- **the canary gate polices quantization loss**: a sane int8 variant is
+  admitted, an over-degraded one (garbage calibration) is rejected
+  through the same relative thresholds every reload candidate faces;
+- **the mux economics run on the measurement**: manifest cost blocks
+  are adopted at ``add()``, ``set_measured_cost`` flips declared →
+  measured live, and residency eviction picks its victim by the
+  measured scalar even when the declared bootstrap says otherwise.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from gan_deeplearning4j_tpu.deploy.canary import CanaryGate  # noqa: E402
+from gan_deeplearning4j_tpu.nn import (  # noqa: E402
+    DenseLayer,
+    GraphBuilder,
+    GraphConfig,
+    InputType,
+    OutputLayer,
+)
+from gan_deeplearning4j_tpu.nn.layers import layer_from_dict  # noqa: E402
+from gan_deeplearning4j_tpu.quant import (  # noqa: E402
+    QuantDenseLayer,
+    build_bf16_variant,
+    build_int8_variant,
+    calibrate_activation_scales,
+    cast_params_bf16,
+    default_calibration_rows,
+    manifest_cost,
+    measure_engine_cost,
+    quantize_classifier,
+    quantize_dense_params,
+    write_cost_block,
+)
+from gan_deeplearning4j_tpu.serving import ServingEngine  # noqa: E402
+from gan_deeplearning4j_tpu.serving.mux import MuxRegistry  # noqa: E402
+from gan_deeplearning4j_tpu.utils import write_model  # noqa: E402
+from gan_deeplearning4j_tpu.utils.serializer import read_model  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+Z, FEAT, CLASSES, HIDDEN = 4, 6, 3, 5
+
+
+def tiny_generator(seed=1):
+    b = GraphBuilder(GraphConfig(seed=seed))
+    b.add_inputs("z").set_input_types(InputType.feed_forward(Z))
+    b.add_layer("g_dense_1", DenseLayer(n_out=8, activation="tanh"), "z")
+    b.add_layer(
+        "g_out", OutputLayer(n_out=FEAT, activation="sigmoid", loss="xent"),
+        "g_dense_1",
+    )
+    b.set_outputs("g_out")
+    return b.build()
+
+
+def tiny_classifier(seed=2):
+    b = GraphBuilder(GraphConfig(seed=seed))
+    b.add_inputs("x").set_input_types(InputType.feed_forward(FEAT))
+    b.add_layer("feat_1", DenseLayer(n_out=HIDDEN, activation="tanh"), "x")
+    b.add_layer(
+        "cv_out",
+        OutputLayer(n_out=CLASSES, activation="softmax", loss="mcxent"),
+        "feat_1",
+    )
+    b.set_outputs("cv_out")
+    return b.build()
+
+
+def confident_cv_params(cv):
+    """Classifier params with well-separated logits (weights scaled up),
+    so int8 rounding cannot flip argmax decisions on the probe rows —
+    the 'trained' incumbent the canary accuracy probe needs."""
+    params = cv.init()
+    rng = np.random.default_rng(7)
+
+    def _scale(leaf):
+        a = np.asarray(leaf)
+        if a.ndim == 2:  # weights: re-draw wide
+            return jnp.asarray(
+                rng.standard_normal(a.shape).astype(np.float32) * 2.0)
+        return jnp.asarray(a)
+
+    return jax.tree_util.tree_map(_scale, params)
+
+
+def write_fp32_bundle(directory, *, confident=False, generation=0):
+    os.makedirs(directory, exist_ok=True)
+    gen, cv = tiny_generator(), tiny_classifier()
+    cv_params = confident_cv_params(cv) if confident else cv.init()
+    write_model(os.path.join(directory, "gen.zip"), gen, gen.init(),
+                save_updater=False)
+    write_model(os.path.join(directory, "cv.zip"), cv, cv_params,
+                save_updater=False)
+    manifest = {
+        "format_version": 1,
+        "generator": "gen.zip",
+        "classifier": "cv.zip",
+        "feature_vertex": "feat_1",
+        "generation": generation,
+        "step": 0,
+    }
+    with open(os.path.join(directory, "serving.json"), "w") as fh:
+        json.dump(manifest, fh)
+    return manifest
+
+
+def engine(directory, **kw):
+    kw.setdefault("buckets", (1, 8))
+    kw.setdefault("export_gauge", False)
+    e = ServingEngine.from_bundle(directory, **kw)
+    e.warmup()
+    return e
+
+
+# ===========================================================================
+# calibration determinism
+# ===========================================================================
+
+class TestCalibrationDeterminism:
+    def test_scales_bit_identical_across_independent_builds(self, tmp_path):
+        src = str(tmp_path / "src")
+        write_fp32_bundle(src)
+        m1 = build_int8_variant(src, str(tmp_path / "a"))
+        m2 = build_int8_variant(src, str(tmp_path / "b"))
+        s1 = m1["quant"]["calibration"]["activation_scales"]
+        s2 = m2["quant"]["calibration"]["activation_scales"]
+        # bit-identical floats, not approximately equal
+        assert s1 == s2
+        assert set(s1) == {"feat_1", "cv_out"}
+        assert all(v > 0 for v in s1.values())
+
+    def test_calibrate_twice_from_fresh_loads(self, tmp_path):
+        src = str(tmp_path / "src")
+        write_fp32_bundle(src)
+        rows = default_calibration_rows(FEAT, num_rows=32)
+        scales = []
+        for _ in range(2):
+            graph, params, _, _ = read_model(
+                os.path.join(src, "cv.zip"), load_updater=False)
+            scales.append(calibrate_activation_scales(graph, params, rows))
+        assert scales[0] == scales[1]
+
+    def test_fallback_rows_are_seeded_and_stable(self):
+        a = default_calibration_rows(FEAT, num_rows=16, seed=5)
+        b = default_calibration_rows(FEAT, num_rows=16, seed=5)
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == np.float32 and a.shape == (16, FEAT)
+
+    def test_manifest_provenance_names_row_source(self, tmp_path):
+        src = str(tmp_path / "src")
+        write_fp32_bundle(src)
+        fallback = build_int8_variant(src, str(tmp_path / "f"))
+        caller = build_int8_variant(
+            src, str(tmp_path / "c"),
+            calibration_rows=np.ones((8, FEAT), np.float32))
+        assert (fallback["quant"]["calibration"]["source"]
+                == "seeded_fallback")
+        assert (caller["quant"]["calibration"]["source"]
+                == "caller_probe_batch")
+        assert caller["quant"]["calibration"]["num_rows"] == 8
+
+
+# ===========================================================================
+# output tolerance vs fp32
+# ===========================================================================
+
+class TestOutputTolerance:
+    def test_bf16_variant_outputs_close_on_every_kind(self, tmp_path):
+        src, var = str(tmp_path / "src"), str(tmp_path / "bf16")
+        write_fp32_bundle(src)
+        m = build_bf16_variant(src, var)
+        assert m["precision"] == "bf16"
+        e_fp, e_bf = engine(src), engine(var)
+        assert e_bf.stats()["precision"] == "bf16"
+        for kind in e_fp.kinds:
+            rows = np.random.default_rng(3).random(
+                (5, e_fp.input_width(kind))).astype(np.float32)
+            a = np.asarray(e_fp.run(kind, rows), np.float32)
+            b = np.asarray(e_bf.run(kind, rows), np.float32)
+            # bf16 has ~3 decimal digits; outputs here are O(1)
+            np.testing.assert_allclose(a, b, atol=0.05), kind
+
+    def test_int8_classifier_close_and_generator_byte_identical(
+            self, tmp_path):
+        src, var = str(tmp_path / "src"), str(tmp_path / "int8")
+        write_fp32_bundle(src, confident=True)
+        m = build_int8_variant(src, var)
+        assert m["precision"] == "int8"
+        with open(os.path.join(src, "gen.zip"), "rb") as fh:
+            src_gen = fh.read()
+        with open(os.path.join(var, "gen.zip"), "rb") as fh:
+            var_gen = fh.read()
+        assert src_gen == var_gen
+        e_fp, e_q = engine(src), engine(var)
+        rows = np.random.default_rng(4).random((6, FEAT)).astype(np.float32)
+        a = np.asarray(e_fp.run("classify", rows), np.float32)
+        b = np.asarray(e_q.run("classify", rows), np.float32)
+        # per-channel symmetric PTQ on a 2-dense classifier: probability
+        # error stays well inside the canary's accuracy tolerance
+        np.testing.assert_allclose(a, b, atol=0.08)
+        assert (np.argmax(a, axis=1) == np.argmax(b, axis=1)).all()
+
+    def test_quant_dense_params_reconstruct_weights(self):
+        w = np.random.default_rng(5).standard_normal(
+            (FEAT, CLASSES)).astype(np.float32)
+        b = np.zeros((CLASSES,), np.float32)
+        q = quantize_dense_params(w, b, act_scale=0.01)
+        assert np.asarray(q["W_q"]).dtype == np.int8
+        recon = np.asarray(q["W_q"], np.float32) * np.asarray(q["w_scale"])
+        # per-output-channel scale: worst-case error is half a quantum
+        quantum = np.asarray(q["w_scale"])[None, :]
+        assert (np.abs(recon - w) <= quantum * 0.5 + 1e-7).all()
+
+
+# ===========================================================================
+# quantized-bundle round-trip
+# ===========================================================================
+
+class TestQuantBundleRoundTrip:
+    def test_int8_params_survive_serializer_exactly(self, tmp_path):
+        cv = tiny_classifier()
+        rows = default_calibration_rows(FEAT, num_rows=16)
+        qgraph, qparams, _ = quantize_classifier(cv, cv.init(), rows)
+        path = str(tmp_path / "q.zip")
+        write_model(path, qgraph, qparams, save_updater=False)
+        graph2, params2, _, _ = read_model(path, load_updater=False)
+        for name in ("feat_1", "cv_out"):
+            v = next(v for v in graph2.vertices if v.name == name)
+            assert isinstance(v.layer, QuantDenseLayer)
+            np.testing.assert_array_equal(
+                np.asarray(qparams[name]["W_q"]),
+                np.asarray(params2[name]["W_q"]))
+            assert np.asarray(params2[name]["W_q"]).dtype == np.int8
+            np.testing.assert_array_equal(
+                np.asarray(qparams[name]["w_scale"]),
+                np.asarray(params2[name]["w_scale"]))
+
+    def test_act_scale_survives_graph_dict_round_trip(self):
+        cv = tiny_classifier()
+        rows = default_calibration_rows(FEAT, num_rows=16)
+        qgraph, _, scales = quantize_classifier(cv, cv.init(), rows)
+        rebuilt = type(qgraph).from_dict(qgraph.to_dict())
+        for v in rebuilt.vertices:
+            if isinstance(v.layer, QuantDenseLayer):
+                assert v.layer.act_scale == scales[v.name]
+
+    def test_quantized_bundle_serves_through_from_bundle(self, tmp_path):
+        src, var = str(tmp_path / "src"), str(tmp_path / "int8")
+        write_fp32_bundle(src)
+        build_int8_variant(src, var)
+        e = engine(var)
+        assert set(e.kinds) == {"sample", "classify", "features"}
+        out = np.asarray(e.run("classify",
+                               np.ones((3, FEAT), np.float32)))
+        assert out.shape == (3, CLASSES)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-3)
+
+    def test_layer_resolves_lazily_without_importing_quant(self, tmp_path):
+        # a reload process that never imported quant/ must still load a
+        # quantized bundle: layer_from_dict imports the owning module on
+        # first sight of the type name
+        src, var = str(tmp_path / "src"), str(tmp_path / "int8")
+        write_fp32_bundle(src)
+        build_int8_variant(src, var)
+        code = (
+            "import sys\n"
+            "from gan_deeplearning4j_tpu.utils.serializer import read_model\n"
+            "assert not any(m.startswith('gan_deeplearning4j_tpu.quant')\n"
+            "               for m in sys.modules), 'quant imported eagerly'\n"
+            f"g, p, _, _ = read_model({os.path.join(var, 'cv.zip')!r},\n"
+            "                        load_updater=False)\n"
+            "kinds = {type(v.layer).__name__ for v in g.vertices if v.layer}\n"
+            "assert 'QuantDenseLayer' in kinds, kinds\n"
+            "print('lazy-ok')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        assert "lazy-ok" in proc.stdout
+
+    def test_bf16_variant_int8_refused_without_classifier(self, tmp_path):
+        # generator-only bundle: bf16 builds, int8 refuses loudly
+        src = str(tmp_path / "src")
+        os.makedirs(src)
+        gen = tiny_generator()
+        write_model(os.path.join(src, "gen.zip"), gen, gen.init(),
+                    save_updater=False)
+        with open(os.path.join(src, "serving.json"), "w") as fh:
+            json.dump({"format_version": 1, "generator": "gen.zip",
+                       "generation": 0, "step": 0}, fh)
+        m = build_bf16_variant(src, str(tmp_path / "bf16"))
+        assert m["precision"] == "bf16"
+        with pytest.raises(ValueError, match="no classifier"):
+            build_int8_variant(src, str(tmp_path / "int8"))
+
+
+# ===========================================================================
+# canary gating of quantization loss
+# ===========================================================================
+
+class TestCanaryGatesQuantization:
+    def _gate_fixture(self, tmp_path):
+        src = str(tmp_path / "src")
+        write_fp32_bundle(src, confident=True)
+        e_fp = engine(src)
+        rows = np.random.default_rng(11).random(
+            (48, FEAT)).astype(np.float32)
+        # labels from the fp32 incumbent itself: incumbent accuracy is
+        # 1.0 by construction, so a real degradation is visible through
+        # the relative accuracy floor
+        labels = np.argmax(np.asarray(e_fp.run("classify", rows)), axis=1)
+        gate = CanaryGate(rows, labels, num_samples=16, seed=1)
+        return src, e_fp, rows, gate
+
+    def test_sane_int8_variant_admitted(self, tmp_path):
+        src, e_fp, rows, gate = self._gate_fixture(tmp_path)
+        var = str(tmp_path / "int8")
+        build_int8_variant(src, var, calibration_rows=rows)
+        decision = gate.evaluate(engine(var), e_fp)
+        assert decision.passed, decision.reason
+        assert decision.candidate["accuracy"] is not None
+
+    def test_over_degraded_int8_rejected(self, tmp_path):
+        src, e_fp, rows, gate = self._gate_fixture(tmp_path)
+        var = str(tmp_path / "degraded")
+        # garbage calibration: probe rows a billion times out of range
+        # drive the activation scales so high every input quantizes to
+        # zero — classify collapses to a constant prediction
+        build_int8_variant(src, var, calibration_rows=rows * 1e9)
+        decision = gate.evaluate(engine(var), e_fp)
+        assert not decision.passed
+        assert "accuracy" in decision.reason
+
+
+# ===========================================================================
+# measured cost + mux economics
+# ===========================================================================
+
+class _FakeEngine:
+    def __init__(self, name, generation=None):
+        self.name = name
+        self.generation = generation
+        self.warmed = True
+        self.kinds = ("sample",)
+
+    def warmup(self, background=False):
+        return {}
+
+    def input_width(self, kind):
+        return Z
+
+    def dispatch(self, kind, rows_list):
+        return types.SimpleNamespace(
+            lane=0, rows=[np.asarray(r) for r in rows_list])
+
+    def finalize(self, flight):
+        return np.concatenate(flight.rows)
+
+
+def fake_registry(budget=2):
+    return MuxRegistry(
+        buckets=(1, 8), budget=budget,
+        build=lambda v: _FakeEngine(v.name, generation=v.generation),
+        batcher_kwargs={"max_latency": 0.0, "default_timeout": 2.0})
+
+
+def cost_block(scalar, resident=1000):
+    return {"cost_schema": 1, "scalar": scalar, "per_row_s": 1e-6,
+            "resident_param_bytes": resident, "precision": "fp32"}
+
+
+class TestMeasuredCostEconomics:
+    def test_measured_engine_cost_prices_bf16_below_fp32(self, tmp_path):
+        src, var = str(tmp_path / "src"), str(tmp_path / "bf16")
+        write_fp32_bundle(src)
+        build_bf16_variant(src, var)
+        b_fp = measure_engine_cost(engine(src), rounds=1)
+        b_bf = measure_engine_cost(engine(var), rounds=1)
+        assert b_bf["resident_param_bytes"] * 2 == b_fp[
+            "resident_param_bytes"]
+        assert b_bf["precision"] == "bf16"
+        assert set(b_fp["per_bucket_s"]) == {"sample", "classify",
+                                             "features"}
+        assert b_fp["scalar"] > 0
+
+    def test_cost_block_manifest_round_trip(self, tmp_path):
+        d = str(tmp_path / "b")
+        write_fp32_bundle(d)
+        assert manifest_cost(d) is None  # bootstrap: no block yet
+        write_cost_block(d, cost_block(3.5))
+        block = manifest_cost(d)
+        assert block is not None and block["scalar"] == 3.5
+        # a garbage block is a bootstrap case, not an adoption
+        write_cost_block(d, {"scalar": -1})
+        assert manifest_cost(d) is None
+
+    def test_add_adopts_manifest_cost_block(self, tmp_path):
+        d = str(tmp_path / "b")
+        write_fp32_bundle(d)
+        write_cost_block(d, cost_block(0.25, resident=512))
+        reg = fake_registry()
+        v = reg.add("m", bundle_path=d, cost=4.0, weight=0.0)
+        assert v.cost == 0.25 and v.cost_source == "measured"
+        assert v.declared_cost == 4.0
+        snap = reg.snapshot()["variants"]["m"]
+        assert snap["cost_source"] == "measured"
+        assert snap["declared_cost"] == 4.0
+        assert snap["resident_param_bytes"] == 512
+
+    def test_set_measured_cost_flips_declared_to_measured(self):
+        reg = fake_registry()
+        reg.add("m", bundle_path="/nowhere", cost=4.0, weight=0.0)
+        assert reg.cost_sources() == {"m": "declared"}
+        reg.set_measured_cost("m", cost_block(0.5))
+        assert reg.cost_sources() == {"m": "measured"}
+        assert reg.costs() == {"m": 0.5}
+        assert any(e["event"] == "cost_measured" for e in reg.events)
+        with pytest.raises(ValueError, match="positive"):
+            reg.set_measured_cost("m", {"scalar": 0})
+
+    def test_eviction_victim_follows_measured_not_declared(self):
+        # declared says "a" is the expensive one; the measurement says
+        # "b" is. At equal weight the budget must demote "b" first.
+        reg = fake_registry(budget=2)
+        reg.add("a", bundle_path="/a", cost=9.0, weight=0.4)
+        reg.add("b", bundle_path="/b", cost=1.0, weight=0.4)
+        reg.set_measured_cost("a", cost_block(0.1))
+        reg.set_measured_cost("b", cost_block(7.0))
+        reg.add("c", bundle_path="/c", cost=1.0, weight=0.4)
+        assert sorted(reg.resident_names()) == ["a", "c"]
+        assert reg.variant("b").state == "cold"
