@@ -74,6 +74,9 @@ from gan_deeplearning4j_tpu.analysis.rules.release_balance import (
 from gan_deeplearning4j_tpu.analysis.rules.handoff import (
     HandoffWithoutTransfer,
 )
+from gan_deeplearning4j_tpu.analysis.rules.ladder_literal import (
+    HardcodedLadderLiteral,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -106,6 +109,7 @@ RULES = [
     UnbalancedRelease(),
     HandoffWithoutTransfer(),
     QuantPrecisionCastMismatch(),
+    HardcodedLadderLiteral(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -70,6 +70,14 @@ def _default_build(candidate: BundleCandidate, live):
     )
 
 
+def _ladder_priority(manifest_buckets, learned, incumbent):
+    """The candidate-build bucket resolution order (docs/SERVING.md):
+    a ladder the bundle's own manifest carries (per-variant, persisted
+    at publish time) > one solved live from the incumbent's recorded
+    traffic > the incumbent's ladder itself."""
+    return manifest_buckets or learned or incumbent
+
+
 class ReloadController:
     """Drives watch → warm → canary → swap against one service.
 
@@ -114,7 +122,7 @@ class ReloadController:
         self.adopt_name = adopt_name
         if build is None:
             build = (self._registry_build if registry is not None
-                     else _default_build)
+                     else self._singleton_build)
         self._build = build
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -201,11 +209,69 @@ class ReloadController:
             self._candidate_generation = candidate_generation
         self._g_state.set(_STATE_CODE[state])
 
+    def _learned_buckets(self, live):
+        """Solve a ladder from the INCUMBENT's recorded request sizes
+        (serving/ladder.py) under the incumbent's compile budget and top
+        bucket — the carry-forward that lets a new generation boot with
+        buckets shaped by the traffic it is about to inherit. None when
+        nothing was recorded yet (or on any solver hiccup: a reload must
+        never fail over ladder learning). The solve is in-memory on
+        purpose — a published generation's bytes are digest-immutable
+        (resilience store) and the directory-mode watcher tokens hash
+        ``serving.json``, so the reload plane never writes the block
+        into a candidate bundle; ``write_ladder_block`` is for
+        publishers, BEFORE the bundle is digested."""
+        if live is None:
+            return None
+        try:
+            if self.registry is not None:
+                name = self.registry.primary_name()
+                if name is None:
+                    return None
+                hist = self.registry.variant(name).histogram
+            else:
+                hist = getattr(self.service.batcher, "size_histogram", None)
+            if hist is None:
+                return None
+            counts = hist.merged()
+            if not counts:
+                return None
+            from gan_deeplearning4j_tpu.serving.ladder import solve_ladder
+
+            return solve_ladder(counts, len(live.buckets),
+                                top=live.buckets[-1])
+        except Exception:
+            logger.exception("learned-ladder solve failed — candidate "
+                             "keeps the incumbent ladder")
+            return None
+
+    def _singleton_build(self, candidate: BundleCandidate, live):
+        """Singleton-mode candidate construction: the bundle's own
+        manifest ladder > a ladder solved from the incumbent batcher's
+        histogram > the live ladder (same top + budget either way, so
+        the batcher's ``max_batch`` and chunking contract carry across
+        the swap); replica count always the live engine's."""
+        from gan_deeplearning4j_tpu.serving.engine import ServingEngine
+        from gan_deeplearning4j_tpu.serving.ladder import manifest_ladder
+
+        buckets = _ladder_priority(manifest_ladder(candidate.path),
+                                   self._learned_buckets(live),
+                                   live.buckets)
+        return ServingEngine.from_bundle(
+            candidate.path,
+            buckets=buckets,
+            replicas=live.replica_count,
+            export_gauge=False,
+        )
+
     def _registry_build(self, candidate: BundleCandidate, live):
         """Mux-mode candidate construction: the registry's ONE build
         recipe (ladder + replicas + shared staging pool), so adopted
-        candidates and budget re-warms can never diverge in config."""
-        return self.registry.build_engine(candidate.path)
+        candidates and budget re-warms can never diverge in config. The
+        incumbent-traffic solve rides along as the fallback for bundles
+        with no manifest ladder of their own."""
+        return self.registry.build_engine(
+            candidate.path, fallback_buckets=self._learned_buckets(live))
 
     # -- forced polls (POST /admin/reload) ------------------------------
     def poll_now(self, wait: bool = False, timeout: float = 60.0) -> dict:

@@ -128,6 +128,12 @@ def main(argv=None) -> int:
                         "on workers that die before becoming routable")
     p.add_argument("--spawn-backoff-max", type=float, default=30.0,
                    help="backoff cap for repeated spawn failures")
+    p.add_argument("--compilation-cache", default=None, metavar="DIR",
+                   help="shared persistent XLA compilation-cache dir "
+                        "passed to every spawned worker: scale-ups, "
+                        "draining restarts, and rolling upgrades reload "
+                        "AOT artifacts instead of recompiling the ladder "
+                        "(warm elasticity — docs/SERVING.md)")
     p.add_argument("--alerts", action="store_true",
                    help="enable the alerting plane (telemetry/alerts.py, "
                         "default fleet rule pack): GET /alerts, healthz "
@@ -231,6 +237,7 @@ def main(argv=None) -> int:
         autoscale=autoscale,
         spawn_backoff_base=args.spawn_backoff,
         spawn_backoff_max=args.spawn_backoff_max,
+        compilation_cache=args.compilation_cache,
     )
     if args.alerts:
         from gan_deeplearning4j_tpu.telemetry.alerts import (

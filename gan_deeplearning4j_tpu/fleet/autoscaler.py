@@ -407,4 +407,12 @@ class Autoscaler:
                     max(0.0, self._cooldown_until - self._clock()), 3),
                 "signals": signals,
                 "brownout_level": self.manager.router.brownout_level,
+                # the reaction-time surface (fleet_scaleup_routable_
+                # seconds): how long the most recent worker admissions
+                # took from launch to routable — what a scale-up
+                # actually buys and when (warm elasticity shrinks this)
+                "scaleup_routable_s": [
+                    round(s.routable_s, 3) for s in self.manager.slots
+                    if s.routable_s is not None
+                ],
             }

@@ -128,6 +128,11 @@ class WorkerSlot:
         self.restarts = 0
         self.open_since: Optional[float] = None  # breaker-open watermark
         self.launched_at: Optional[float] = None  # init-hang watermark
+        # launch-to-first-admission seconds of the CURRENT process — the
+        # elasticity number ("capacity means routable, not spawned"):
+        # what a scale-up or restart actually costs before the router
+        # sends this worker traffic. None until admission.
+        self.routable_s: Optional[float] = None
         # spawn-failure backoff state: a process that dies before EVER
         # earning router admission relaunches on a capped exponential
         # schedule, not in a tight loop (docs/FLEET.md)
@@ -164,7 +169,8 @@ class FleetManager:
                  telemetry: bool = False,
                  autoscale=None,
                  spawn_backoff_base: float = 0.5,
-                 spawn_backoff_max: float = 30.0):
+                 spawn_backoff_max: float = 30.0,
+                 compilation_cache: Optional[str] = None):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         if spawn_backoff_base <= 0 or spawn_backoff_max < spawn_backoff_base:
@@ -193,6 +199,12 @@ class FleetManager:
         # fleet CLI): without it the router's /debug/trace merge would
         # hold router spans only — trace propagation needs both ends
         self.telemetry = telemetry
+        # shared persistent XLA cache dir for EVERY worker spawn (ISSUE
+        # 19 warm elasticity): the first warmup pays the compiles, every
+        # later spawn — scale_up_one, draining restarts, rolling
+        # upgrades — reloads the AOT artifacts instead of recompiling,
+        # which is what makes scale-up-to-routable fast
+        self.compilation_cache = compilation_cache
         self._spawn = spawn or self._spawn_process
         self._env = env
         self.spawn_backoff_base = spawn_backoff_base
@@ -257,6 +269,11 @@ class FleetManager:
             "fleet_spawn_failures_total",
             "worker processes that died before ever becoming routable "
             "(each schedules a backed-off relaunch, never a hot loop)")
+        self._h_routable = registry.histogram(
+            "fleet_scaleup_routable_seconds",
+            "seconds from worker launch to first router admission — the "
+            "autoscaler's real reaction time (capacity means routable, "
+            "not spawned; docs/FLEET.md)")
         # the SLO-driven elastic control loop (fleet/autoscaler.py):
         # ticked by the supervise loop, resizes through scale_up_one /
         # scale_down_one under the same cycle lock rolling upgrades hold
@@ -337,10 +354,13 @@ class FleetManager:
                                   and s.process.alive()),
                         "restarts": s.restarts,
                         "spawn_failures": s.spawn_failures,
+                        "routable_s": (None if s.routable_s is None
+                                       else round(s.routable_s, 3)),
                         "bundle": s.bundle_path,
                     }
                     for s in self.slots
                 ],
+                "compilation_cache": self.compilation_cache,
             }
         if self.autoscaler is not None:
             body["autoscaler"] = self.autoscaler.status()
@@ -370,6 +390,11 @@ class FleetManager:
         ]
         if self.telemetry:
             cmd.append("--telemetry")
+        if self.compilation_cache:
+            # THE warm-elasticity seam: without this flag every spawned
+            # worker recompiled its full ladder from scratch (the bug
+            # ISSUE 19 names) — the serving CLI has honored it since PR 4
+            cmd += ["--compilation-cache", self.compilation_cache]
         return cmd + self.worker_args
 
     def _spawn_process(self, slot: WorkerSlot, bundle_path: str
@@ -383,6 +408,7 @@ class FleetManager:
         slot.bundle_path = bundle_path
         slot.open_since = None
         slot.launched_at = time.monotonic()
+        slot.routable_s = None  # the NEW process re-earns its timing
         # the NEW process has not earned admission yet: if it dies before
         # it does, the relaunch goes through the spawn-failure backoff
         slot.ever_routable = False
@@ -664,6 +690,19 @@ class FleetManager:
             else:
                 slot.open_since = None
                 if state == "closed":
+                    if not slot.ever_routable:
+                        # FIRST admission of this process: record
+                        # launch-to-routable seconds — the number warm
+                        # elasticity (shared compilation cache) shrinks
+                        # and the autoscaler's reaction time is made of
+                        if slot.launched_at is not None:
+                            slot.routable_s = now - slot.launched_at
+                            self._h_routable.observe(slot.routable_s)
+                            with self._lock:
+                                self.events.append({
+                                    "event": "routable",
+                                    "worker": slot.id,
+                                    "seconds": round(slot.routable_s, 3)})
                     # admission earned: this process is no longer a spawn
                     # failure candidate, and the backoff ladder resets
                     slot.ever_routable = True

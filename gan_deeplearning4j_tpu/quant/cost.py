@@ -117,14 +117,14 @@ def measure_bundle_cost(bundle_dir: str, *, buckets=None, replicas: int = 1,
                         write: bool = True) -> dict:
     """Build the bundle's engine off to the side (no generation gauge
     claim), measure it, and (by default) write the ``cost`` block back
-    into its manifest — the one-call path benches and drills use."""
-    from gan_deeplearning4j_tpu.serving.engine import (
-        DEFAULT_BUCKETS,
-        ServingEngine,
-    )
+    into its manifest — the one-call path benches and drills use.
+    ``buckets=None`` resolves the bundle's own learned ladder when the
+    manifest carries one (serving/ladder.py) — a variant with
+    traffic-shaped buckets is priced on the ladder it actually serves."""
+    from gan_deeplearning4j_tpu.serving.engine import ServingEngine
 
     engine = ServingEngine.from_bundle(
-        bundle_dir, buckets=buckets or DEFAULT_BUCKETS,
+        bundle_dir, buckets=buckets,
         replicas=replicas, export_gauge=False)
     block = measure_engine_cost(engine, rounds=rounds)
     if write:

@@ -28,6 +28,12 @@ features) through one dynamic micro-batcher:
   Prometheus text), the served bundle's ``generation``, and the telemetry
   debug hooks (``POST /debug/trace`` device captures, ``GET /debug/spans``
   Chrome trace export — docs/OBSERVABILITY.md);
+- :mod:`.ladder` — traffic-shaped bucket ladders: a bounded flush-size
+  histogram recorded as the batcher assembles each flush, an exact DP
+  (:func:`~.ladder.solve_ladder`) choosing ≤K buckets that minimize
+  expected padded-rows waste, and manifest persistence so a reloaded
+  generation boots with buckets learned from live traffic instead of
+  the 1/8/32/128 default;
 - ``python -m gan_deeplearning4j_tpu.serving`` — the server CLI;
 - :mod:`.mux` — the multi-model multiplexing plane (docs/MULTIPLEX.md):
   N named variants behind deterministic weighted traffic splitting, a
@@ -41,6 +47,13 @@ Architecture notes: docs/SERVING.md.
 
 from gan_deeplearning4j_tpu.serving.batcher import MicroBatcher, ServeResult
 from gan_deeplearning4j_tpu.serving.engine import ServingEngine
+from gan_deeplearning4j_tpu.serving.ladder import (
+    SizeHistogram,
+    expected_waste,
+    manifest_ladder,
+    solve_ladder,
+    write_ladder_block,
+)
 from gan_deeplearning4j_tpu.serving.service import InferenceService, make_server
 
 __all__ = [
@@ -49,4 +62,9 @@ __all__ = [
     "ServingEngine",
     "InferenceService",
     "make_server",
+    "SizeHistogram",
+    "solve_ladder",
+    "expected_waste",
+    "manifest_ladder",
+    "write_ladder_block",
 ]

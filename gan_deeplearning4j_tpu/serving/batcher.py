@@ -189,6 +189,7 @@ class MicroBatcher:
         default_timeout: float = 5.0,
         max_samples: int = 65536,
         pipeline_depth: Optional[int] = None,
+        size_histogram=None,
     ):
         if (run_fn is None) == (engine is None):
             raise ValueError("pass exactly one of run_fn or engine")
@@ -211,6 +212,22 @@ class MicroBatcher:
         self.max_latency = max_latency
         self.max_queue = max_queue
         self.default_timeout = default_timeout
+        # flush-size histogram (serving/ladder.py): recorded per ASSEMBLED
+        # flush in the worker loop — the engine pads coalesced batches,
+        # not individual submits, so the ladder solver must see post-
+        # coalescing sizes (a ladder solved from submit sizes measurably
+        # REGRESSES under concurrency: multi-request flushes land in the
+        # gaps between learned buckets). Exported via metrics(), read by
+        # the reload plane to solve the next generation's bucket ladder.
+        # Injectable so the mux plane can hand each variant ITS OWN
+        # histogram object that survives demote/promote cycles; a
+        # swap_engine keeps this same batcher, so singleton reloads carry
+        # it automatically.
+        if size_histogram is None:
+            from gan_deeplearning4j_tpu.serving.ladder import SizeHistogram
+
+            size_histogram = SizeHistogram()
+        self.size_histogram = size_histogram
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -596,6 +613,11 @@ class MicroBatcher:
                     self._release_slot()
                     continue
                 total = sum(r.rows.shape[0] for r in live)
+                # what the engine just padded: the ASSEMBLED flush, not
+                # the individual submits — the ladder learner's only
+                # footprint, one bounded dict increment per flush
+                # (serving/ladder.py)
+                self.size_histogram.record(live[0].kind, total)
                 # lane = the replica this flush was routed to (stamped by
                 # the engine's dispatch); run_fn handles and fakes without
                 # one ride lane 0. Modulo guards a swap to a wider engine.
@@ -713,6 +735,7 @@ class MicroBatcher:
                 "engine_swaps": self._swaps,
                 "queue_depth": len(self._queue),
                 "batch_occupancy": {str(k): v for k, v in sorted(self._occupancy.items())},
+                "flush_sizes": self.size_histogram.stats(),
                 "latency_ms": lat,
                 "pipeline": {
                     "depth": self.pipeline_depth,

@@ -204,6 +204,11 @@ class ServingEngine:
         self._batch_sharding = None
         self._compile_counts: Dict[str, int] = {k: 0 for k in self._kinds}
         self._serve_compiles: Dict[str, int] = {k: 0 for k in self._kinds}
+        # padded-rows waste ledger: rows the chunker padded past the real
+        # request rows, per kind — the number the learned ladder exists
+        # to shrink (serving/ladder.py); the replay bench reads it as the
+        # measured counterpart of expected_waste()
+        self._padded_waste: Dict[str, int] = {k: 0 for k in self._kinds}
         # telemetry registry mirrors of the compile ledger + routing
         # (docs/OBSERVABILITY.md): the dict above stays the per-engine
         # invariant the bench asserts; the registry series are what a
@@ -223,6 +228,13 @@ class ServingEngine:
         self._c_serve_compiles = {
             k: _serve_c.labels(kind=k) for k in self._kinds
         }
+        _waste = _registry.counter(
+            "serve_padded_rows_wasted_total",
+            "rows padded past the request rows per kind (the learned "
+            "ladder's objective — serving/ladder.py)",
+            labelnames=("kind",),
+        )
+        self._c_waste = {k: _waste.labels(kind=k) for k in self._kinds}
         _dispatches = _registry.counter(
             "serve_engine_dispatches_total",
             "flush dispatches routed per replica",
@@ -295,14 +307,27 @@ class ServingEngine:
 
     @classmethod
     def from_bundle(
-        cls, directory: str, *, buckets: Sequence[int] = DEFAULT_BUCKETS,
+        cls, directory: str, *, buckets: Optional[Sequence[int]] = None,
         replicas: Optional[int] = 1, export_gauge: bool = True,
         staging_pool=None,
     ) -> "ServingEngine":
         """Load a ``serving.json`` bundle published by
-        ``GanExperiment.publish_for_serving``."""
+        ``GanExperiment.publish_for_serving``.
+
+        ``buckets=None`` (the default) resolves the bundle's LEARNED
+        ladder when the manifest carries one (``serving/ladder.py`` —
+        solved from recorded traffic and persisted at reload/publish
+        time), falling back to :data:`DEFAULT_BUCKETS`. Passing an
+        explicit ladder overrides both — reload builds do this to match
+        the live engine's shape."""
         with open(os.path.join(directory, "serving.json")) as fh:
             manifest = json.load(fh)
+        if buckets is None:
+            # lazy import: ladder.py's manifest helpers reach into
+            # quant.variants, which sits above this module
+            from gan_deeplearning4j_tpu.serving.ladder import manifest_ladder
+
+            buckets = manifest_ladder(directory) or DEFAULT_BUCKETS
         if manifest.get("format_version", 0) > 1:
             raise ValueError(
                 f"serving bundle format {manifest['format_version']} is newer "
@@ -436,6 +461,8 @@ class ServingEngine:
                 "replica_in_flight": list(self._outstanding),
                 "compile_counts": dict(self._compile_counts),
                 "serve_compile_counts": dict(self._serve_compiles),
+                "padded_rows_wasted": dict(self._padded_waste),
+                "buckets": list(self.buckets),
                 "compiled_per_replica": per_replica,
                 "warmup": "warm" if self._warmed else (
                     "warming" if self.warming else (
@@ -706,6 +733,11 @@ class ServingEngine:
                     continue
             n = min(top, remaining)
             bucket = self._bucket_for(n)
+            waste = bucket - n
+            if waste:
+                with self._lock:
+                    self._padded_waste[kind] += waste
+                self._c_waste[kind].inc(waste)
             buf = self._checkout(kind, bucket)
             filled = 0
             while filled < n:

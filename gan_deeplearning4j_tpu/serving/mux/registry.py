@@ -48,6 +48,11 @@ from gan_deeplearning4j_tpu.serving.engine import (
     DEFAULT_BUCKETS,
     _StagingBuf,
 )
+from gan_deeplearning4j_tpu.serving.ladder import (
+    SizeHistogram,
+    manifest_histogram,
+    manifest_ladder,
+)
 from gan_deeplearning4j_tpu.serving.mux.splitter import WeightedSplitter
 from gan_deeplearning4j_tpu.telemetry.registry import get_registry
 from gan_deeplearning4j_tpu.telemetry.trace import TRACER
@@ -123,7 +128,7 @@ class MuxVariant:
 
     __slots__ = ("name", "bundle_path", "declared_cost", "measured",
                  "generation", "state", "engine", "batcher", "last_error",
-                 "added_at", "warmed_at")
+                 "added_at", "warmed_at", "histogram")
 
     def __init__(self, name: str, *, bundle_path: Optional[str],
                  cost: float, generation):
@@ -139,6 +144,11 @@ class MuxVariant:
         self.last_error: Optional[str] = None
         self.added_at = time.time()
         self.warmed_at: Optional[float] = None
+        # per-variant flush-size histogram (serving/ladder.py): owned
+        # by the VARIANT, not the batcher, so learned traffic shape
+        # survives demote/re-warm cycles; each residency's batcher
+        # records straight into it
+        self.histogram = SizeHistogram()
 
     @property
     def cost(self) -> float:
@@ -183,6 +193,13 @@ class MuxVariant:
             "bundle_path": self.bundle_path,
             "resident": self.state == "resident",
             "warm": bool(engine is not None and engine.warmed),
+            # the ladder this residency compiled (None while cold) and
+            # how much traffic shape the variant has accumulated — the
+            # learned-ladder observability pair (serving/ladder.py)
+            "buckets": (None if engine is None
+                        else list(getattr(engine, "buckets", ()) or ())
+                        or None),
+            "histogram_rows": self.histogram.total(),
             "last_error": self.last_error,
         }
 
@@ -256,18 +273,23 @@ class MuxRegistry:
             "pins (0 until measured)", labelnames=("model",))
 
     # -- builds (the PR 7 reloader path, shared-pool edition) -------------
-    def build_engine(self, bundle_path: str):
-        """THE build recipe for this registry's engines — the registry's
-        ladder and replica count (every variant compiles the executables
-        the splitter routes to) with the shared staging pool attached.
-        The registry-mode reload plane builds its candidates through
-        this too, so adopted and re-warmed engines can never diverge in
+    def build_engine(self, bundle_path: str,
+                     fallback_buckets: Optional[Sequence[int]] = None):
+        """THE build recipe for this registry's engines — the variant's
+        own LEARNED ladder when its bundle manifest carries one
+        (serving/ladder.py; each variant's traffic shapes its own
+        buckets), else ``fallback_buckets`` (the reload plane passes a
+        ladder solved from the incumbent's histogram), else the registry
+        default; replica count and the shared staging pool always. The
+        registry-mode reload plane builds its candidates through this
+        too, so adopted and re-warmed engines can never diverge in
         config."""
         from gan_deeplearning4j_tpu.serving.engine import ServingEngine
 
         return ServingEngine.from_bundle(
             bundle_path,
-            buckets=self.buckets,
+            buckets=(manifest_ladder(bundle_path) or fallback_buckets
+                     or self.buckets),
             replicas=self.replicas,
             export_gauge=False,
             staging_pool=self.pool,
@@ -280,9 +302,16 @@ class MuxRegistry:
                 f"build from")
         return self.build_engine(variant.bundle_path)
 
-    def _make_batcher(self, engine) -> MicroBatcher:
+    def _make_batcher(self, engine,
+                      variant: Optional[MuxVariant] = None) -> MicroBatcher:
         kwargs = dict(self._batcher_kwargs)
-        kwargs.setdefault("max_batch", self.buckets[-1])
+        # the ENGINE's ladder top, not the registry default: a variant
+        # warmed on its own learned ladder must batch to ITS top bucket
+        # (registry default when the engine carries no ladder)
+        ladder = getattr(engine, "buckets", None) or self.buckets
+        kwargs.setdefault("max_batch", ladder[-1])
+        if variant is not None:
+            kwargs.setdefault("size_histogram", variant.histogram)
         return MicroBatcher(engine=engine, **kwargs)
 
     # -- variant management ----------------------------------------------
@@ -313,6 +342,12 @@ class MuxRegistry:
             block = manifest_cost(bundle_path)
             if block is not None:
                 variant.set_measured(block)
+            # boot the variant's live histogram from the traffic shape
+            # persisted with its bundle (serving/ladder.py), so learning
+            # compounds across generations instead of restarting cold
+            persisted = manifest_histogram(bundle_path)
+            if persisted:
+                variant.histogram.merge(persisted)
         with self.lock:
             if name in self._variants:
                 raise ValueError(f"variant {name!r} already registered")
@@ -334,9 +369,20 @@ class MuxRegistry:
         """The reload plane's entry point (docs/DEPLOY.md): a newly
         warmed candidate engine joins the registry as a variant —
         typically at weight 0, ready for a ramp — instead of replacing a
-        singleton. The residency budget applies immediately."""
+        singleton. The residency budget applies immediately. The
+        incumbent primary's flush-size histogram is folded into the
+        newcomer's (on top of anything its bundle manifest persisted),
+        so the generation that will inherit the traffic also inherits
+        its learned shape (ISSUE 19 carry-forward)."""
+        incumbent = self.primary_name()
         variant = self.add(name, bundle_path=bundle_path, engine=engine,
                            cost=cost, weight=weight, generation=generation)
+        if incumbent is not None and incumbent != name:
+            with self.lock:
+                prior = self._variants.get(incumbent)
+                seed = prior.histogram.snapshot() if prior else None
+            if seed:
+                variant.histogram.merge(seed)
         with self.lock:
             self.events.append({"event": "adopt", "variant": name,
                                 "generation": variant.generation})
@@ -351,7 +397,7 @@ class MuxRegistry:
 
     def _attach_locked(self, variant: MuxVariant, engine) -> None:
         variant.engine = engine
-        variant.batcher = self._make_batcher(engine)
+        variant.batcher = self._make_batcher(engine, variant)
         variant.state = "resident"
         variant.warmed_at = time.time()
         variant.last_error = None

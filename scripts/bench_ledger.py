@@ -145,6 +145,24 @@ SPEC = {
         Metric("canary_failures", "results.canary_failures", "lower",
                0.0, max_abs=0),
     ],
+    "ladder": [
+        # measured padded-rows waste, learned/baseline on identical
+        # request draws — the traffic-shaped ladder must keep beating
+        # the fixed 1/8/32/128 guess (< 1 means it does); the record's
+        # own invariants additionally hard-gate zero-lost and
+        # no-serve-time-compiles
+        Metric("waste_ratio_measured", "waste.ratio", "lower", 0.25,
+               max_abs=0.999),
+        Metric("waste_ratio_analytic",
+               "ladder.analytic_padded_rows.ratio", "info"),
+        # compile-cache warm elasticity: track the warm/cold warmup
+        # split, don't gate it (CPU wall noise; tiny bench models)
+        Metric("warm_warmup_s", "elasticity.warm_warmup_s", "info"),
+        Metric("cold_warmup_s", "elasticity.cold_warmup_s", "info"),
+        Metric("lost",
+               ["phases.learned.lost", "phases.baseline.lost"],
+               "lower", 0.0, max_abs=0),
+    ],
     "train": [],  # raw bench dumps: invariants/ok gating only
 }
 

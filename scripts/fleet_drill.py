@@ -1108,8 +1108,9 @@ def run_mux(args) -> int:
             bundles.append((gen_number, store.latest_valid().path))
         log(f"seeded serving generations "
             f"{[n for n, _ in bundles]} into {serve_store}")
+        drill_buckets = (1, 8)  # the ladder every drill engine serves
         registry = MuxRegistry(
-            buckets=(1, 8), budget=3,
+            buckets=drill_buckets, budget=3,
             batcher_kwargs={"max_latency": 0.002, "max_queue": 12,
                             "default_timeout": 5.0})
         # the cost gradient the brownout sheds by: "heavy" is the
@@ -1128,7 +1129,9 @@ def run_mux(args) -> int:
 
         lite_dir = os.path.join(workdir, "variant_bf16")
         build_bf16_variant(bundles[1][1], lite_dir)
-        measure_bundle_cost(lite_dir, buckets=(1, 8), rounds=2)
+        # price the variant on the ladder the registry will serve it on
+        # (a literal here would shadow a learned manifest ladder — JG031)
+        measure_bundle_cost(lite_dir, buckets=drill_buckets, rounds=2)
         registry.add("heavy", bundle_path=bundles[0][1], cost=4.0,
                      weight=0.9, generation=bundles[0][0])
         registry.add("lite", bundle_path=lite_dir, cost=1.0,
@@ -1514,6 +1517,11 @@ def main(argv=None) -> int:
                 "--canary-samples", "32",
                 "--canary-fid-ratio", "1.1", "--canary-fid-slack", "0.5",
                 "--boot-wait", "60", "--telemetry",
+                # warm elasticity (ISSUE 19): every worker — including
+                # re-spawns and scale-ups — shares one persistent XLA
+                # cache, so restarts reuse AOT artifacts instead of
+                # recompiling the ladder
+                "--compilation-cache", os.path.join(workdir, "xla_cache"),
             ],
             cwd=_REPO, env=_ENV, stdout=fleet_log, stderr=fleet_log,
         )
@@ -1548,9 +1556,23 @@ def main(argv=None) -> int:
             "old_pid": victim.get("pid"),
             "new_pid": worker_by_id(recovered or {}, "w0").get("pid"),
             "restarts": worker_by_id(recovered or {}, "w0").get("restarts"),
+            "routable_s": worker_by_id(recovered or {}, "w0").get(
+                "routable_s"),
             "counts_at_recovery": dict(load.counts),
         }
         invariants["sigkill_worker_relaunched"] = bool(recovered)
+        # the re-spawned worker warmed its whole ladder before admission
+        # (shared --compilation-cache makes that warmup AOT-reusable);
+        # no request may ever pay a serve-time compile on the new pid
+        _, w0_metrics = http_json(
+            "GET", f"http://127.0.0.1:{worker_ports[0]}/metrics",
+            timeout=5.0)
+        respawn_compiles = ((w0_metrics or {}).get("engine") or {}).get(
+            "serve_compile_counts", {})
+        results["sigkill"]["serve_compile_counts"] = respawn_compiles
+        invariants["respawned_worker_no_serve_compiles"] = bool(
+            respawn_compiles) and all(
+            v == 0 for v in respawn_compiles.values())
 
         # -- phase 2: SIGSTOP (hang) + half-open re-admission -----------
         health = fleet_health(base)
@@ -1690,6 +1712,13 @@ def main(argv=None) -> int:
         results["generations_served"] = sorted(monitor.generations_served)
         results["routable_envelope"] = [monitor.min_routable,
                                         monitor.max_routable]
+        # launch-to-routable per worker (fleet_scaleup_routable_seconds
+        # feeds the same numbers to /metrics) — the elasticity surface
+        # scale-ups and re-spawns are judged on
+        final_health = fleet_health(base)
+        results["scaleup_routable_s"] = {
+            w["id"]: w.get("routable_s")
+            for w in (final_health.get("fleet") or {}).get("workers", [])}
         invariants["exactly_one_answer_zero_lost"] = (
             counts["lost"] == 0
             and counts["ok"] + counts["shed"] + counts["error"]

@@ -36,6 +36,21 @@ of ``main``); on a real TPU it routes across the chips that exist.
 ``--record TAG`` additionally writes ``BENCH_serving_<TAG>.json`` at the
 repo root so the serving perf trajectory is tracked alongside the
 training bench files.
+
+``--replay TRACE.json`` (ISSUE 19) switches to the traffic-shaped
+ladder bench: replay a checked-in heavy-tail request-size trace twice
+against the same bundle — once on the fixed ``--replay-baseline``
+ladder, once on the ladder ``solve_ladder`` learns from the trace at
+the same compile budget — and report measured padded-rows waste, p99,
+and compile counts side by side (identical request draws, so the
+comparison is paired). The replay run also measures compile-cache warm
+elasticity: cold engine warmup fills the persistent XLA cache, then a
+fresh engine re-warms from it — the scale-up-to-routable delta a
+restarted fleet worker sees. ``--record TAG`` writes
+``BENCH_ladder_<TAG>.json`` (the ``ladder`` ledger family)::
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py \\
+        --replay scripts/data/heavy_tail_trace.json --record r01
 """
 
 from __future__ import annotations
@@ -315,6 +330,197 @@ def run_bench(args) -> dict:
     return summary
 
 
+def _replay_phase(engine, args, kinds, width, trace_sizes, threads):
+    """Drive one engine over the trace draws; return its measured side
+    of the A/B (waste, latency, compiles, zero-lost ledger)."""
+    from gan_deeplearning4j_tpu.serving import InferenceService
+
+    service = InferenceService(
+        engine,
+        max_latency=args.max_latency,
+        max_queue=args.max_queue,
+        default_timeout=args.timeout,
+        warmup=False,  # the replay warms (and times) the engine itself
+        pipeline_depth=args.pipeline_depth,
+    )
+    statuses, rows_ok, elapsed = _drive(
+        service, kinds, width, trace_sizes, len(trace_sizes), threads,
+        args.seed,
+    )
+    metrics = service.metrics()
+    stats = engine.stats()
+    flush_counts = service.batcher.size_histogram.merged()
+    service.close()
+    submitted = threads * (len(trace_sizes) // threads)
+    wasted = stats["padded_rows_wasted"]
+    return flush_counts, {
+        "buckets": list(engine.buckets),
+        "requests": submitted,
+        "ok": sum(1 for s in statuses if s == "ok"),
+        "shed": sum(1 for s in statuses if s in ("overloaded", "deadline")),
+        "errors": sum(1 for s in statuses if s == "error"),
+        "lost": submitted - len(statuses),
+        "rows_ok": rows_ok,
+        "elapsed_s": elapsed,
+        "throughput_rows_per_s": rows_ok / elapsed if elapsed > 0 else 0.0,
+        "latency_ms": metrics["latency_ms"],
+        "padded_rows_wasted": dict(wasted),
+        "padded_rows_wasted_total": sum(wasted.values()),
+        "compile_counts": dict(engine.compile_counts),
+        "compiles_total": sum(engine.compile_counts.values()),
+        "serve_compile_counts": dict(engine.serve_compile_counts),
+        "expected_max_compiles": engine.expected_max_compiles,
+    }
+
+
+def run_replay(args) -> dict:
+    """Paired heavy-tail replay: learned ladder vs fixed baseline at the
+    same compile budget, plus compile-cache warm elasticity.
+
+    The learned ladder is solved from the FLUSH-size histogram the
+    baseline pass records — the same signal the reload plane learns from
+    a live incumbent — because the engine pads coalesced flushes, not
+    individual submits. Both passes replay identical request draws, so
+    the waste comparison is paired."""
+    import jax
+
+    from gan_deeplearning4j_tpu.serving import (
+        ServingEngine,
+        expected_waste,
+        solve_ladder,
+    )
+
+    with open(args.replay) as fh:
+        trace = json.load(fh)
+    trace_sizes = [int(s) for s in trace.get("sizes", []) if int(s) >= 1]
+    if not trace_sizes:
+        raise SystemExit(f"replay trace {args.replay} has no sizes")
+    threads = args.threads
+    if args.smoke:
+        trace_sizes = trace_sizes[:96]
+        threads = min(threads, 4)
+
+    baseline = tuple(sorted(set(args.replay_baseline)))
+    top = baseline[-1]
+
+    # main() enabled the persistent cache BEFORE any jax compile (jax
+    # latches a disabled cache at the process's first compile otherwise);
+    # the tiny bench models compile in <1s, below the default persist
+    # threshold, so the replay lowers it for the elasticity measurement.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bundle = build_bundle(tmp, seed=args.seed)
+        width = {"sample": bundle["z_size"],
+                 "classify": bundle["num_features"],
+                 "features": bundle["num_features"]}
+
+        def build(buckets):
+            return ServingEngine.from_checkpoints(
+                generator=bundle["generator"],
+                classifier=bundle["classifier"],
+                buckets=buckets,
+                feature_vertex=bundle["feature_vertex"],
+                replicas=args.replicas,
+            )
+
+        # -- calibration + baseline measurement: the incumbent-shaped
+        # pass. Its cold warmup fills the persistent cache (timed for
+        # the elasticity half), and its batcher histogram is the solver
+        # input — exactly what a live reload learns from.
+        base_engine = build(baseline)
+        t0 = time.perf_counter()
+        base_engine.warmup()
+        cold_s = time.perf_counter() - t0
+        kinds = list(base_engine.kinds)
+        flush_counts, baseline_phase = _replay_phase(
+            base_engine, args, kinds, width, trace_sizes, threads)
+
+        learned = solve_ladder(flush_counts, budget=len(baseline), top=top)
+        analytic = {
+            "baseline_rows": expected_waste(flush_counts, baseline),
+            "learned_rows": expected_waste(flush_counts, learned),
+        }
+        analytic["ratio"] = (
+            analytic["learned_rows"] / analytic["baseline_rows"]
+            if analytic["baseline_rows"] > 0 else 0.0)
+
+        engine = build(learned)
+        engine.warmup()
+        _, learned_phase = _replay_phase(
+            engine, args, kinds, width, trace_sizes, threads)
+
+        # -- elasticity: a fresh engine on the ladder the cold pass
+        # compiled re-warms from the persistent cache — the same AOT
+        # reuse a restarted fleet worker (scale_up_one, rolling upgrade)
+        # gets from a shared --compilation-cache dir.
+        jax.clear_caches()  # drop in-memory executables, keep persistent
+        warm_engine = build(baseline)
+        t0 = time.perf_counter()
+        warm_engine.warmup()
+        warm_s = time.perf_counter() - t0
+        elasticity = {
+            "cache_dir": args.compilation_cache,
+            "cold_warmup_s": cold_s,
+            "warm_warmup_s": warm_s,
+            "speedup": cold_s / warm_s if warm_s > 0 else 0.0,
+        }
+
+    measured = {
+        "baseline_rows": baseline_phase["padded_rows_wasted_total"],
+        "learned_rows": learned_phase["padded_rows_wasted_total"],
+    }
+    measured["ratio"] = (
+        measured["learned_rows"] / measured["baseline_rows"]
+        if measured["baseline_rows"] > 0 else 0.0)
+
+    summary = {
+        "bench": "serve_replay",
+        "config": {
+            "trace": os.path.relpath(args.replay, _REPO),
+            "trace_name": trace.get("name"),
+            "requests": len(trace_sizes),
+            "distinct_sizes": len(set(trace_sizes)),
+            "threads": threads,
+            "replicas": args.replicas,
+            "smoke": bool(args.smoke),
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "ladder": {
+            "baseline": list(baseline),
+            "learned": list(learned),
+            "budget": len(baseline),
+            "analytic_padded_rows": analytic,
+            "solved_from_flush_sizes": {
+                str(s): c for s, c in sorted(flush_counts.items())},
+        },
+        "phases": {
+            "baseline": baseline_phase,
+            "learned": learned_phase,
+        },
+        "waste": measured,
+        "elasticity": elasticity,
+        "invariants": {
+            "zero_lost": all(
+                p["lost"] == 0 and p["errors"] == 0
+                for p in (baseline_phase, learned_phase)),
+            "no_serve_time_compiles": all(
+                c == 0
+                for p in (baseline_phase, learned_phase)
+                for c in p["serve_compile_counts"].values()),
+            "compiles_bounded": all(
+                c <= p["expected_max_compiles"]
+                for p in (baseline_phase, learned_phase)
+                for c in p["compile_counts"].values()),
+            "learned_ladder_within_budget": len(learned) <= len(baseline),
+            "learned_waste_not_worse": (
+                analytic["learned_rows"] <= analytic["baseline_rows"]
+                and measured["learned_rows"] <= measured["baseline_rows"]),
+        },
+    }
+    return summary
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=200)
@@ -344,6 +550,14 @@ def main(argv=None) -> int:
     p.add_argument("--overload-threads", type=int, default=16)
     p.add_argument("--overload-queue", type=int, default=4)
     p.add_argument("--overload-timeout", type=float, default=0.5)
+    p.add_argument("--replay", default=None, metavar="TRACE.json",
+                   help="replay a recorded request-size trace: learned "
+                        "ladder vs --replay-baseline at the same compile "
+                        "budget, plus compile-cache warm elasticity")
+    p.add_argument("--replay-baseline", default="1,8,32,128",
+                   type=lambda s: tuple(int(b) for b in s.split(",")),
+                   help="fixed ladder the learned one is paired against "
+                        "(the pre-ISSUE-19 default)")
     p.add_argument("--smoke", action="store_true",
                    help="small fixed shape for CI/campaign gating")
     p.add_argument("--seed", type=int, default=666)
@@ -383,6 +597,12 @@ def main(argv=None) -> int:
                 + f" --xla_force_host_platform_device_count={args.replicas}"
             ).strip()
 
+    if args.replay and not args.compilation_cache:
+        # the replay's elasticity phase needs a persistent cache; it must
+        # be enabled HERE, before the first jax compile — jax latches a
+        # disabled cache at first compile and ignores later dir changes
+        args.compilation_cache = tempfile.mkdtemp(prefix="serve_replay_xla_")
+
     if args.compilation_cache:
         from gan_deeplearning4j_tpu.runtime.environment import (
             enable_compilation_cache,
@@ -395,7 +615,7 @@ def main(argv=None) -> int:
     if args.telemetry or args.trace:
         TRACER.enable()
 
-    summary = run_bench(args)
+    summary = run_replay(args) if args.replay else run_bench(args)
     if args.trace:
         TRACER.dump(args.trace, {"source": "serve_bench",
                                  "smoke": bool(args.smoke)})
@@ -404,11 +624,15 @@ def main(argv=None) -> int:
         json.dump(summary, fh, indent=2)
         fh.write("\n")
     if args.record:
-        with open(os.path.join(_REPO, f"BENCH_serving_{args.record}.json"),
+        family = "ladder" if args.replay else "serving"
+        with open(os.path.join(_REPO, f"BENCH_{family}_{args.record}.json"),
                   "w") as fh:
             json.dump(summary, fh, indent=2)
             fh.write("\n")
-    sys.stdout.write(json.dumps(summary["results"], indent=2) + "\n")
+    headline = ({"ladder": summary["ladder"], "waste": summary["waste"],
+                 "elasticity": summary["elasticity"]}
+                if args.replay else summary["results"])
+    sys.stdout.write(json.dumps(headline, indent=2) + "\n")
     if summary.get("compare"):
         sys.stdout.write(json.dumps({"compare": summary["compare"]}, indent=2)
                          + "\n")

@@ -63,6 +63,18 @@ if ! timeout 120 python scripts/trace_report.py \
   echo "$(date +%H:%M:%S) trace_report gate failed — campaign aborted (see trace_report.log)" >> tpu_poller.log
   exit 1
 fi
+# Ladder replay smoke (CPU, checked-in heavy-tail trace): the learned
+# bucket ladder must keep beating the fixed 1/8/32/128 default on the
+# padded-rows objective at the same compile budget, with the zero-lost /
+# no-serve-time-compile invariants intact, and the persistent-cache warm
+# warmup must still measure (serve_bench --replay exits nonzero on any
+# invariant breach — docs/SERVING.md "Learned ladder & warm elasticity").
+if ! JAX_PLATFORMS=cpu timeout 600 python scripts/serve_bench.py --smoke \
+    --replay scripts/data/heavy_tail_trace.json \
+    --output artifacts/serve_replay_smoke.json > serve_replay_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) ladder replay smoke failed — campaign aborted (see serve_replay_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 # Resilience smoke (CPU, subprocess kill drill): the campaign's long runs
 # survive preemption only if the supervisor/store contract holds — refuse
 # to start if bit-exact resume, corruption quarantine, or the relaunch

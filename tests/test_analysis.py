@@ -3989,6 +3989,68 @@ class TestQuantPrecisionCastMismatch:
 
 
 # ===========================================================================
+# JG031 — hard-coded bucket ladder at a manifest-carrying load seam
+# ===========================================================================
+
+class TestHardcodedLadderLiteral:
+    def test_true_positive_from_bundle_literal_list(self):
+        # the bug the rule was derived from: a literal ladder at the
+        # bundle seam silently overrides the learned manifest ladder
+        r = run(
+            "def load(path):\n"
+            "    from serving.engine import ServingEngine\n"
+            "    return ServingEngine.from_bundle(\n"
+            "        path, buckets=[1, 8, 32, 128], replicas=2)\n"
+        )
+        assert codes(r) == ["JG031"]
+        assert "manifest ladder" in r.active[0].message
+
+    def test_true_positive_measure_bundle_cost_literal_tuple(self):
+        # pricing a variant on a ladder it will never serve: the cost
+        # block lands in the manifest next to the ladder it contradicts
+        r = run(
+            "from quant.cost import measure_bundle_cost\n"
+            "def price(bundle_dir):\n"
+            "    return measure_bundle_cost(bundle_dir, buckets=(1, 8))\n"
+        )
+        assert codes(r) == ["JG031"]
+
+    def test_true_negative_buckets_none_and_absent(self):
+        # the correct spellings: omit the kwarg or pass None — both let
+        # the bundle's learned manifest ladder resolve
+        r = run(
+            "def load(path, engine_cls):\n"
+            "    a = engine_cls.from_bundle(path)\n"
+            "    b = engine_cls.from_bundle(path, buckets=None)\n"
+            "    return a, b\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_computed_ladder(self):
+        # a variable ladder is an operator/solver decision, not a guess:
+        # args.buckets, DEFAULT_BUCKETS, or a solved ladder all pass
+        r = run(
+            "from serving.engine import DEFAULT_BUCKETS\n"
+            "def load(path, engine_cls, args, learned):\n"
+            "    a = engine_cls.from_bundle(path, buckets=args.buckets)\n"
+            "    b = engine_cls.from_bundle(path, buckets=DEFAULT_BUCKETS)\n"
+            "    c = engine_cls.from_bundle(path, buckets=learned or None)\n"
+            "    return a, b, c\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_from_checkpoints_literal(self):
+        # raw checkpoints carry no manifest — a literal ladder is the
+        # only way to say anything at that seam
+        r = run(
+            "def load(gen, cv, engine_cls):\n"
+            "    return engine_cls.from_checkpoints(\n"
+            "        generator=gen, classifier=cv, buckets=(1, 8, 32))\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
 # JG025 cross-class unification (satellite on the concurrency index)
 # ===========================================================================
 

@@ -1,0 +1,413 @@
+"""serving/ladder tests: the exact bucket-ladder DP and its waste
+oracle, the bounded flush-size histogram, manifest persistence
+round-trips, the batcher's flush-seam recording, the mux registry's
+per-variant ladders + adoption carry-forward, the reload plane's
+learned-ladder resolution order, and the fleet manager's
+compilation-cache propagation (ISSUE 19; docs/SERVING.md).
+
+Everything here is jax-free: the DP/histogram are pure python, the
+batcher runs in ``run_fn`` mode, and the registry/reloader use
+engine-shaped fakes — millisecond tests for the learning loop's
+invariants."""
+
+import types
+
+import numpy as np
+import pytest
+
+from gan_deeplearning4j_tpu.deploy.reloader import (
+    ReloadController,
+    _ladder_priority,
+)
+from gan_deeplearning4j_tpu.fleet.manager import FleetManager
+from gan_deeplearning4j_tpu.fleet.router import FleetRouter
+from gan_deeplearning4j_tpu.serving.batcher import MicroBatcher
+from gan_deeplearning4j_tpu.serving.ladder import (
+    SizeHistogram,
+    expected_waste,
+    manifest_histogram,
+    manifest_ladder,
+    solve_ladder,
+    write_ladder_block,
+)
+from gan_deeplearning4j_tpu.serving.mux import MuxRegistry
+from gan_deeplearning4j_tpu.quant.variants import (
+    read_bundle_manifest,
+    write_bundle_manifest,
+)
+
+
+# ===========================================================================
+# solve_ladder — the exact DP
+# ===========================================================================
+
+class TestSolveLadder:
+    def test_empty_histogram_returns_top(self):
+        assert solve_ladder({}, budget=4, top=128) == (128,)
+
+    def test_budget_one_degenerates_to_top(self):
+        assert solve_ladder({3: 50, 7: 9}, budget=1, top=128) == (128,)
+
+    def test_budget_below_one_raises(self):
+        with pytest.raises(ValueError):
+            solve_ladder({3: 1}, budget=0, top=128)
+
+    def test_empty_histogram_without_top_raises(self):
+        with pytest.raises(ValueError):
+            solve_ladder({}, budget=4)
+
+    def test_free_budget_places_bucket_at_every_remainder(self):
+        # 128 % 128 == 0 drops out; three remainders, three free slots
+        counts = {3: 50, 4: 20, 100: 7, 128: 3}
+        ladder = solve_ladder(counts, budget=4, top=128)
+        assert ladder == (3, 4, 100, 128)
+        assert expected_waste(counts, ladder) == 0
+
+    def test_constrained_budget_picks_the_cheapest_cut(self):
+        # one free bucket among {4, 5, 64}: at 5 the hundred 4-row
+        # flushes pad 1 row each (100) and the 64s fall to top (640);
+        # at 4 the single 5 and the 64s fall to top (123 + 640); at 64
+        # the 4s pad 60 rows each. 740 < 763 < 6059.
+        counts = {4: 100, 5: 1, 64: 10}
+        ladder = solve_ladder(counts, budget=2, top=128)
+        assert ladder == (5, 128)
+        assert expected_waste(counts, ladder) == 740
+        assert expected_waste(counts, (4, 128)) == 763
+
+    def test_solution_matches_brute_force(self):
+        import itertools
+
+        counts = {2: 30, 3: 11, 9: 8, 17: 40, 31: 5, 60: 2}
+        top, budget = 64, 3
+        best = min(
+            (expected_waste(counts, combo + (top,))
+             for k in range(1, budget)
+             for combo in itertools.combinations(sorted(counts), k)),
+            default=expected_waste(counts, (top,)))
+        ladder = solve_ladder(counts, budget=budget, top=top)
+        assert expected_waste(counts, ladder) == best
+
+    def test_deterministic_under_dict_order(self):
+        counts = {4: 100, 5: 1, 64: 10, 17: 3}
+        reversed_counts = dict(reversed(list(counts.items())))
+        assert (solve_ladder(counts, budget=3, top=128)
+                == solve_ladder(reversed_counts, budget=3, top=128))
+
+    def test_top_always_present_even_when_never_observed(self):
+        ladder = solve_ladder({3: 10}, budget=4, top=99)
+        assert ladder[-1] == 99 and ladder == (3, 99)
+
+    def test_sizes_above_top_fold_to_remainders(self):
+        # 130 % 128 == 2: the chunker serves a full waste-free 128-chunk
+        # plus a 2-row remainder — the DP must plan for the 2, not 130
+        assert solve_ladder({130: 5}, budget=2, top=128) == (2, 128)
+        # exact multiples of top are entirely waste-free: nothing to learn
+        assert solve_ladder({256: 9}, budget=4, top=128) == (128,)
+
+    def test_string_keys_accepted(self):
+        # JSON round-trips histogram keys as strings
+        assert solve_ladder({"3": "7"}, budget=2, top=16) == (3, 16)
+
+    def test_top_defaults_to_largest_observed(self):
+        assert solve_ladder({3: 5, 40: 1}, budget=2, top=None)[-1] == 40
+
+
+class TestExpectedWaste:
+    def test_hand_computed(self):
+        # 2 flushes of 3 pad to 4 (waste 2), one of 10 pads to 16 (6)
+        assert expected_waste({3: 2, 10: 1}, (4, 16)) == 8
+
+    def test_full_chunks_are_waste_free(self):
+        assert expected_waste({16: 5, 32: 2}, (4, 16)) == 0
+        # 20 = one 16-chunk + remainder 4 → pads to bucket 4, waste 0
+        assert expected_waste({20: 3}, (4, 16)) == 0
+
+    def test_bad_ladder_raises(self):
+        with pytest.raises(ValueError):
+            expected_waste({3: 1}, ())
+        with pytest.raises(ValueError):
+            expected_waste({3: 1}, (0, 8))
+
+
+# ===========================================================================
+# SizeHistogram — bounded, thread-safe, JSON-tolerant
+# ===========================================================================
+
+class TestSizeHistogram:
+    def test_record_snapshot_total(self):
+        h = SizeHistogram()
+        h.record("sample", 3)
+        h.record("sample", 3)
+        h.record("discriminate", 7)
+        assert h.snapshot() == {"sample": {3: 2}, "discriminate": {7: 1}}
+        assert h.total() == 3
+
+    def test_nonpositive_records_ignored(self):
+        h = SizeHistogram()
+        h.record("sample", 0)
+        h.record("sample", -4)
+        assert h.total() == 0
+
+    def test_merged_pools_across_kinds(self):
+        h = SizeHistogram()
+        h.record("a", 3)
+        h.record("b", 3)
+        h.record("b", 9)
+        assert h.merged() == {3: 2, 9: 1}
+
+    def test_merge_accepts_json_string_keys(self):
+        h = SizeHistogram()
+        h.merge({"sample": {"4": "6", "junk": 1, "2": 0}})
+        assert h.snapshot() == {"sample": {4: 6}}
+
+    def test_overflow_folds_up_then_to_largest(self):
+        h = SizeHistogram(max_sizes=2)
+        h.record("k", 5)
+        h.record("k", 10)
+        h.record("k", 7)   # unseen, folds UP to 10 (conservative)
+        h.record("k", 20)  # above everything: folds into largest (10)
+        assert h.snapshot() == {"k": {5: 1, 10: 3}}
+        assert h.stats()["folded"] == 2
+
+    def test_max_sizes_below_one_raises(self):
+        with pytest.raises(ValueError):
+            SizeHistogram(max_sizes=0)
+
+    def test_stats_block_shape(self):
+        h = SizeHistogram()
+        h.record("sample", 4)
+        s = h.stats()
+        assert s["total"] == 1 and s["folded"] == 0
+        assert s["kinds"] == {"sample": {"4": 1}}  # str keys: JSON-ready
+
+
+# ===========================================================================
+# manifest persistence — the ladder travels with the bundle
+# ===========================================================================
+
+class TestManifestRoundTrip:
+    def seed(self, tmp_path):
+        d = str(tmp_path)
+        write_bundle_manifest(d, {"generation": 7})
+        return d
+
+    def test_write_then_read_back(self, tmp_path):
+        d = self.seed(tmp_path)
+        write_ladder_block(d, [8, 1, 8, 32],
+                           histogram={"sample": {3: 50, 130: 2}},
+                           solved_from={"total_rows": 52})
+        assert manifest_ladder(d) == (1, 8, 32)  # sorted, deduped
+        assert manifest_histogram(d) == {"sample": {3: 50, 130: 2}}
+        # rides NEXT TO existing manifest keys, never replaces them
+        assert read_bundle_manifest(d)["generation"] == 7
+
+    def test_bad_ladder_rejected_at_write(self, tmp_path):
+        d = self.seed(tmp_path)
+        with pytest.raises(ValueError):
+            write_ladder_block(d, [])
+        with pytest.raises(ValueError):
+            write_ladder_block(d, [0, 8])
+
+    def test_absent_and_malformed_blocks_read_as_none(self, tmp_path):
+        d = self.seed(tmp_path)
+        assert manifest_ladder(d) is None
+        assert manifest_histogram(d) is None
+        # malformed blocks must degrade to defaults, never fail a load
+        write_bundle_manifest(d, {"ladder": {"buckets": ["x"],
+                                             "histogram": "nope"}})
+        assert manifest_ladder(d) is None
+        assert manifest_histogram(d) is None
+        assert manifest_ladder(str(tmp_path / "missing")) is None
+
+
+# ===========================================================================
+# batcher — the flush seam is the only recording site
+# ===========================================================================
+
+class TestBatcherFlushRecording:
+    def test_flush_sizes_recorded_and_exported(self):
+        mb = MicroBatcher(lambda kind, rows: rows * 2.0,
+                          max_batch=16, max_latency=0.0,
+                          default_timeout=5.0)
+        try:
+            for n in (3, 3, 7):
+                r = mb.submit("sample", np.zeros((n, 2), np.float32))
+                assert r.ok
+            # sequential submits → each flush is exactly one request
+            assert mb.size_histogram.snapshot() == {"sample": {3: 2, 7: 1}}
+            assert mb.metrics()["flush_sizes"]["total"] == 3
+        finally:
+            mb.close()
+
+    def test_injected_histogram_is_shared(self):
+        # the mux hands each variant's batcher the VARIANT's histogram;
+        # the seam is the constructor kwarg
+        h = SizeHistogram()
+        mb = MicroBatcher(lambda kind, rows: rows, max_batch=8,
+                          max_latency=0.0, default_timeout=5.0,
+                          size_histogram=h)
+        try:
+            assert mb.submit("sample", np.ones((4, 2), np.float32)).ok
+            assert h.merged() == {4: 1}
+            assert mb.size_histogram is h
+        finally:
+            mb.close()
+
+
+# ===========================================================================
+# mux registry — per-variant ladders + adoption carry-forward
+# ===========================================================================
+
+class _LadderFake:
+    """Engine-shaped fake carrying its own learned ladder."""
+
+    def __init__(self, name, buckets=None, generation=None):
+        self.name = name
+        self.generation = generation
+        self.warmed = True
+        self.warm_failed = False
+        self.kinds = ("sample",)
+        if buckets is not None:
+            self.buckets = tuple(buckets)
+
+    def warmup(self, background=False):
+        return {}
+
+    def input_width(self, kind):
+        return 2
+
+    def dispatch(self, kind, rows_list):
+        return types.SimpleNamespace(
+            lane=0, rows=[np.asarray(r) for r in rows_list])
+
+    def finalize(self, flight):
+        return np.concatenate(flight.rows)
+
+
+def _registry(**kw):
+    kw.setdefault("batcher_kwargs",
+                  {"max_latency": 0.0, "default_timeout": 2.0})
+    return MuxRegistry(buckets=(1, 8), budget=4,
+                       build=lambda variant: _LadderFake(variant.name),
+                       **kw)
+
+
+class TestMuxPerVariantLadder:
+    def test_batcher_tops_out_at_the_engines_own_ladder(self):
+        reg = _registry()
+        try:
+            reg.add("wide", engine=_LadderFake("wide", buckets=(4, 64)),
+                    weight=1.0)
+            reg.add("plain", engine=_LadderFake("plain"), weight=0.0)
+            assert reg.variant("wide").batcher.max_batch == 64
+            # no ladder on the engine → the registry default's top
+            assert reg.variant("plain").batcher.max_batch == 8
+        finally:
+            reg.close()
+
+    def test_status_surfaces_buckets_and_histogram_rows(self):
+        reg = _registry()
+        try:
+            reg.add("v", engine=_LadderFake("v", buckets=(2, 16)),
+                    weight=1.0)
+            reg.variant("v").histogram.record("sample", 5)
+            snap = reg.snapshot()["variants"]["v"]
+            assert snap["buckets"] == [2, 16]
+            assert snap["histogram_rows"] == 1
+        finally:
+            reg.close()
+
+    def test_adoption_inherits_incumbent_traffic_shape(self):
+        # the generation that inherits the traffic inherits its learned
+        # shape: the incumbent primary's flush histogram folds into the
+        # newcomer's on adopt (ISSUE 19 carry-forward)
+        reg = _registry()
+        try:
+            reg.add("gen-1", engine=_LadderFake("gen-1"), weight=1.0)
+            reg.variant("gen-1").histogram.record("sample", 3)
+            reg.variant("gen-1").histogram.record("sample", 3)
+            reg.adopt("gen-2", _LadderFake("gen-2"), weight=0.0)
+            assert reg.variant("gen-2").histogram.merged() == {3: 2}
+            # a copy, not shared state: new traffic diverges
+            reg.variant("gen-2").histogram.record("sample", 9)
+            assert reg.variant("gen-1").histogram.merged() == {3: 2}
+        finally:
+            reg.close()
+
+
+# ===========================================================================
+# reload plane — resolution order + learned solve
+# ===========================================================================
+
+class TestReloaderLadder:
+    def test_priority_manifest_then_learned_then_incumbent(self):
+        assert _ladder_priority((1, 4), (2, 8), (1, 8)) == (1, 4)
+        assert _ladder_priority(None, (2, 8), (1, 8)) == (2, 8)
+        assert _ladder_priority(None, None, (1, 8)) == (1, 8)
+
+    def _controller(self, histogram):
+        service = types.SimpleNamespace(
+            batcher=types.SimpleNamespace(size_histogram=histogram))
+        watcher = types.SimpleNamespace(path=None)
+        return ReloadController(service, watcher, poll_interval=1.0)
+
+    def test_learned_buckets_solves_under_incumbent_contract(self):
+        h = SizeHistogram()
+        for _ in range(50):
+            h.record("sample", 3)
+        live = types.SimpleNamespace(buckets=(1, 8, 32, 128))
+        ladder = self._controller(h)._learned_buckets(live)
+        # budget = len(incumbent ladder), top = incumbent top: the
+        # chunking contract (max_batch, bulk lane) survives the swap
+        assert ladder is not None
+        assert ladder[-1] == 128 and len(ladder) <= 4
+        assert 3 in ladder
+
+    def test_learned_buckets_none_when_nothing_recorded(self):
+        ctl = self._controller(SizeHistogram())
+        assert ctl._learned_buckets(
+            types.SimpleNamespace(buckets=(1, 8))) is None
+        assert ctl._learned_buckets(None) is None
+
+    def test_learned_buckets_swallows_solver_failure(self):
+        # a reload must never fail over ladder learning
+        class Boom:
+            def merged(self):
+                raise RuntimeError("solver hiccup")
+
+        ctl = self._controller(Boom())
+        assert ctl._learned_buckets(
+            types.SimpleNamespace(buckets=(1, 8))) is None
+
+
+# ===========================================================================
+# fleet — compilation-cache propagation (warm elasticity)
+# ===========================================================================
+
+class TestFleetCompilationCache:
+    def test_worker_cmd_carries_cache_flag(self, tmp_path):
+        m = FleetManager(FleetRouter(), str(tmp_path), num_workers=1,
+                         ports=[1], spawn=lambda slot, bundle: None,
+                         compilation_cache=str(tmp_path / "xla"))
+        cmd = m._worker_cmd(m.slots[0], "/bundle")
+        i = cmd.index("--compilation-cache")
+        assert cmd[i + 1] == str(tmp_path / "xla")
+        assert m.status()["compilation_cache"] == str(tmp_path / "xla")
+
+    def test_worker_cmd_omits_flag_when_unset(self, tmp_path):
+        m = FleetManager(FleetRouter(), str(tmp_path), num_workers=1,
+                         ports=[2], spawn=lambda slot, bundle: None)
+        assert "--compilation-cache" not in m._worker_cmd(
+            m.slots[0], "/bundle")
+        assert m.status()["compilation_cache"] is None
+
+    def test_launch_resets_routable_clock(self, tmp_path):
+        m = FleetManager(FleetRouter(), str(tmp_path), num_workers=1,
+                         ports=[3],
+                         spawn=lambda slot, bundle:
+                         types.SimpleNamespace(pid=1234))
+        slot = m.slots[0]
+        slot.routable_s = 1.23  # stale timing from a dead process
+        m._launch(slot, "/bundle")
+        # the NEW process re-earns its launch→routable timing
+        assert slot.routable_s is None
