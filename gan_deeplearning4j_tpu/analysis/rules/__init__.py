@@ -77,6 +77,9 @@ from gan_deeplearning4j_tpu.analysis.rules.handoff import (
 from gan_deeplearning4j_tpu.analysis.rules.ladder_literal import (
     HardcodedLadderLiteral,
 )
+from gan_deeplearning4j_tpu.analysis.rules.double_buffer import (
+    DoubleBufferMisuse,
+)
 
 RULES = [
     PrngKeyReuse(),
@@ -110,6 +113,7 @@ RULES = [
     HandoffWithoutTransfer(),
     QuantPrecisionCastMismatch(),
     HardcodedLadderLiteral(),
+    DoubleBufferMisuse(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}

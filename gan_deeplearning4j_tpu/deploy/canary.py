@@ -165,13 +165,21 @@ class CanaryGate:
     def __init__(self, features, labels=None, *, num_samples: int = 256,
                  seed: int = 666, feature_fn=None,
                  thresholds: Optional[CanaryThresholds] = None,
-                 probe: Optional[Callable] = None):
+                 probe: Optional[Callable] = None,
+                 dataset: Optional[str] = None):
         self.features = np.asarray(features, dtype=np.float32)
         if self.features.ndim != 2 or self.features.shape[0] < 2:
             raise ValueError(
                 f"canary needs (n >= 2, d) real rows, got "
                 f"{self.features.shape}")
         self.labels = None if labels is None else np.asarray(labels)
+        #: the zoo dataset identity of ``features`` (docs/ZOO.md). When set,
+        #: a candidate bundle whose manifest declares a DIFFERENT dataset is
+        #: rejected WITHOUT probing — a Fashion-MNIST generator FID-scored
+        #: against MNIST reals is a meaningless number that could pass or
+        #: fail arbitrarily, so the gate fails closed instead. None keeps
+        #: the pre-zoo behavior (probe whatever arrives).
+        self.dataset = dataset
         self.num_samples = int(num_samples)
         if self.num_samples < 2:
             raise ValueError("num_samples must be >= 2 (covariance fit)")
@@ -194,10 +202,28 @@ class CanaryGate:
         classify_fn = None
         if "classify" in engine.kinds and self.labels is not None:
             classify_fn = lambda rows: engine.run("classify", rows)  # noqa: E731
+        sample_fn = lambda z: engine.run("sample", z)  # noqa: E731
+        z_size = engine.input_width("sample")
+        if getattr(engine, "conditional", False):
+            # Conditional bundle: the probe draws BASE-z latents and the
+            # gate supplies a cycling one-hot class block (every class
+            # represented) — uniform noise in the embedding slots would
+            # probe off the trained input manifold and score garbage.
+            classes = engine.class_count
+            z_size = engine.latent_width("sample")
+            labels = np.arange(self.num_samples) % classes
+            onehot = np.eye(classes, dtype=np.float32)[labels]
+
+            def sample_fn(z, _onehot=onehot):  # noqa: F811
+                return engine.run(
+                    "sample",
+                    np.concatenate([z, _onehot[: z.shape[0]]], axis=1),
+                )
+
         return quality_probe(
-            lambda z: engine.run("sample", z),
+            sample_fn,
             self.features,
-            z_size=engine.input_width("sample"),
+            z_size=z_size,
             num_samples=self.num_samples,
             seed=self.seed,
             classify_fn=classify_fn,
@@ -215,10 +241,31 @@ class CanaryGate:
         return result
 
     # -- the gate --------------------------------------------------------
+    def dataset_mismatch(self, engine) -> Optional[str]:
+        """The rejection reason when ``engine``'s manifest declares a zoo
+        dataset other than this gate's real rows, else None. Pre-zoo
+        bundles (no scenario) and gates built without a ``dataset`` are
+        never mismatched — the check is additive over legacy behavior."""
+        if self.dataset is None:
+            return None
+        scenario = getattr(engine, "scenario", None)
+        declared = scenario.get("dataset") if scenario else None
+        if declared is not None and declared != self.dataset:
+            return (f"candidate bundle trains dataset {declared!r} but the "
+                    f"gate's real rows are {self.dataset!r} — refusing to "
+                    f"FID-score across datasets")
+        return None
+
     def evaluate(self, candidate, incumbent) -> CanaryDecision:
         """Admit or reject ``candidate`` relative to ``incumbent`` — the
         measurement here, the decision in :func:`compare_probes` (shared
-        with the fleet manager's sidecar canary)."""
+        with the fleet manager's sidecar canary). A candidate declaring a
+        different zoo dataset than the gate's real rows fails CLOSED,
+        before any probe runs."""
+        mismatch = self.dataset_mismatch(candidate)
+        if mismatch is not None:
+            return CanaryDecision(
+                passed=False, reason=mismatch, candidate={}, incumbent={})
         inc = self._incumbent_probe(incumbent)
         cand = self.probe(candidate)
         decision = compare_probes(cand, inc, self.thresholds)

@@ -56,6 +56,21 @@ class ExperimentConfig:
     checkpoint_every: int = 1
     resume: bool = False  # restore states from output_dir before training
 
+    # -- model zoo scenario axes (zoo/manifest.py, docs/ZOO.md) --------------
+    # "none" keeps the reference's unconditional generator; "class" widens
+    # the generator/gan input to [z | one-hot(class)] (the label embedding
+    # is the first dense layer's extra rows) and trains the generator on
+    # the real batch's labels. The discriminator — and through it the
+    # transfer classifier — stays unconditional, so the paper's dis-feature
+    # transfer claim is untouched. Serving-side, a conditional bundle
+    # accepts ``POST /v1/sample?class=k`` (docs/SERVING.md).
+    conditioning: str = "none"
+    # Which dataset identity this run trains against ("mnist" |
+    # "fashion_mnist" | "cifar_shaped"). Keys the zoo data loaders AND the
+    # canary gate's real-rows identity: a bundle is only FID-scored against
+    # reals of its own dataset (deploy/canary.py fails closed on mismatch).
+    dataset: str = "mnist"
+
     # -- WGAN-GP (BASELINE.md config 5; ignored by the XENT families) --------
     # critic steps per generator step; the incoming train batch is split into
     # n_critic equal critic minibatches (batch_size_train % n_critic == 0)
@@ -163,6 +178,23 @@ class ExperimentConfig:
             raise ValueError(
                 f"dis_lr_decay_rate {self.dis_lr_decay_rate} must be in (0, 1]"
             )
+        if self.conditioning not in ("none", "class"):
+            raise ValueError(
+                f"unknown conditioning {self.conditioning!r} "
+                f"(want 'none' or 'class')"
+            )
+        if self.conditioning == "class":
+            if self.num_classes < 2:
+                raise ValueError(
+                    "class-conditional training needs num_classes >= 2 "
+                    "(the one-hot label embedding is the condition)"
+                )
+            if self.distributed == "param_averaging":
+                raise ValueError(
+                    "conditioning='class' runs on the fused paths (single-"
+                    "chip or pmean); the param-averaging phased path keeps "
+                    "the reference's unconditional loop"
+                )
         from gan_deeplearning4j_tpu.runtime.dtype import parse_compute_dtype
 
         parse_compute_dtype(self.compute_dtype)  # raises on unknown dtype
@@ -171,6 +203,13 @@ class ExperimentConfig:
 
         family = registry.get(self.model_family)  # raises on unknown family
         if family.name == "wgan_gp":
+            if self.conditioning == "class":
+                raise ValueError(
+                    "conditioning='class' is a GraphTrainer-family feature "
+                    "(the fused alternating loop concatenates the label "
+                    "embedding); the WGAN-GP critic-round program is "
+                    "unconditional — queued in ROADMAP.md"
+                )
             if self.n_critic < 1 or self.batch_size_train % self.n_critic:
                 raise ValueError(
                     f"wgan_gp: batch_size_train {self.batch_size_train} must be "

@@ -21,6 +21,7 @@ one batched device→host fetch instead of per-scalar ``getDouble`` reads
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import re
@@ -151,15 +152,32 @@ class GanExperiment:
         self.family = registry.get(cfg.model_family)
         self.model_cfg = self.family.make_model_config(cfg)
         self.dis_to_gan, self.gan_to_gen = self.family.sync_maps(self.model_cfg)
+        # Class conditioning (zoo/manifest.py, docs/ZOO.md): the generator
+        # and stacked GAN take [z | one-hot(class)] — the label embedding is
+        # the extra one-hot rows of the first dense layer — while the
+        # discriminator (and through it the transfer classifier) stays
+        # unconditional, preserving the paper's dis-feature transfer claim.
+        # model_cfg keeps the BASE z_size; only the gen/gan graph configs
+        # widen. Weight-sync maps are layer-NAME keyed, so they are width-
+        # agnostic and carry over unchanged.
+        self._cond_classes = cfg.num_classes if cfg.conditioning == "class" else 0
 
         if mesh is None and cfg.distributed != "none":
             mesh = TpuEnvironment().make_mesh()
         self.mesh = mesh
 
         # the three graphs (+ MNIST's transfer classifier, I4-I6, I11)
+        gen_cfg = (
+            dataclasses.replace(
+                self.model_cfg,
+                z_size=self.model_cfg.z_size + self._cond_classes,
+            )
+            if self._cond_classes
+            else self.model_cfg
+        )
         self.dis = self.family.build_discriminator(self.model_cfg)
-        self.gen = self.family.build_generator(self.model_cfg)
-        self.gan = self.family.build_gan(self.model_cfg)
+        self.gen = self.family.build_generator(gen_cfg)
+        self.gan = self.family.build_gan(gen_cfg)
         dis_params = self.dis.init()
         if self.family.build_transfer_classifier is not None:
             self.cv, cv_params = self.family.build_transfer_classifier(
@@ -213,7 +231,7 @@ class GanExperiment:
         self._eps_real = self._soft_noise(b)
         self._eps_fake = self._soft_noise(b)
         self._z_rng = np.random.default_rng(cfg.seed + 1)
-        self._z_grid = latent_grid(cfg.latent_grid, cfg.z_size)
+        self._z_grid = self._with_condition(latent_grid(cfg.latent_grid, cfg.z_size))
 
         self.timer = PhaseTimer()
         self.metrics = MetricsLogger(cfg.metrics_jsonl)
@@ -356,9 +374,23 @@ class GanExperiment:
             * self._noise_rng.standard_normal((n, 1)).astype(np.float32)
         )
 
+    def _with_condition(self, z: np.ndarray) -> np.ndarray:
+        """Widen host-side latents with a cycling one-hot class column block
+        (row i conditions on class i mod C) — identity when unconditional.
+        Keeps every host z consumer (grid export, phased-path draws) valid
+        against the widened generator input."""
+        if not self._cond_classes:
+            return z
+        labels = np.arange(z.shape[0]) % self._cond_classes
+        onehot = np.eye(self._cond_classes, dtype=np.float32)[labels]
+        return np.concatenate([z, onehot], axis=1)
+
     def _sample_z(self, n: int) -> np.ndarray:
-        """z ~ U(−1,1) via rand·2−1 (reference :420,465)."""
-        return (self._z_rng.random((n, self.config.z_size), dtype=np.float32) * 2.0 - 1.0)
+        """z ~ U(−1,1) via rand·2−1 (reference :420,465); conditional runs
+        append the cycling one-hot embedding."""
+        return self._with_condition(
+            self._z_rng.random((n, self.config.z_size), dtype=np.float32) * 2.0 - 1.0
+        )
 
     @staticmethod
     def _copied_layers(src_params: Dict, mapping: Dict[str, str]) -> Dict:
@@ -386,6 +418,7 @@ class GanExperiment:
         gen_graph = self.gen
         one_step, rebind = _one_opt_step, _rebind
         z_size = self.model_cfg.z_size
+        cond = self._cond_classes > 0
         base_key = jax.random.PRNGKey(self.config.seed + 2)
         cfg = self.config
         resample = cfg.resample_label_noise
@@ -423,6 +456,14 @@ class GanExperiment:
             dis_scale = _dis_lr_scale(cfg, dis_state.step)
             z_fake = jax.random.uniform(k_fake, (b, z_size), jnp.float32, -1.0, 1.0)
             z_gan = jax.random.uniform(k_gan, (b, z_size), jnp.float32, -1.0, 1.0)
+            if cond:
+                # class conditioning: condition BOTH generator passes on the
+                # real batch's labels — the dis sees matched real/fake class
+                # mix and the generator step learns p(x|class). The base-z
+                # RNG stream is untouched (same draws as unconditional).
+                onehot = real_l.astype(jnp.float32)
+                z_fake = jnp.concatenate([z_fake, onehot], axis=1)
+                z_gan = jnp.concatenate([z_gan, onehot], axis=1)
             # (a) fake batch from the frozen sampler
             fake = gen_graph.output(gen_params, z_fake, train=False)
             fake = fake.reshape(real_f.shape)
@@ -898,6 +939,12 @@ class GanExperiment:
         write_csv(path, preds, precision=6)
         return path
 
+    def _publish_step(self) -> int:
+        """The step counter published artifacts are labeled with. The gan
+        graph steps once per loop iteration here; the WGAN-GP experiment
+        (no stacked gan) overrides this with its generator's step."""
+        return int(self.gan_state.step)
+
     def save_models(self, directory: Optional[str] = None) -> List[str]:
         """All four models with updater state, every iteration (I16).
         ``directory`` overrides ``config.output_dir`` — the resume entry
@@ -968,7 +1015,7 @@ class GanExperiment:
             meta={
                 "shard_index": int(shard_index),
                 "shard_count": int(shard_count),
-                "step": int(self.gan_state.step),
+                "step": self._publish_step(),
                 "total_keys": len(flat),
                 # compute-side update sharding: when on, this worker's
                 # resident updater rows are exactly this shard's updater
@@ -1010,7 +1057,7 @@ class GanExperiment:
                 lambda d: result.update(
                     self._write_serving_bundle(d, generation=number)
                 ),
-                step=int(self.gan_state.step),
+                step=self._publish_step(),
                 extra={"kind": "serving"},
             )
             if generation.number != number:
@@ -1058,9 +1105,18 @@ class GanExperiment:
             "z_size": int(self.model_cfg.z_size),
             "num_features": int(cfg.num_features),
             "num_classes": int(cfg.num_classes),
-            "step": int(self.gan_state.step),
+            "step": self._publish_step(),
             "generation": generation,
         }
+        # Scenario identity (zoo/manifest.py): the serving engine keys the
+        # conditional `sample?class=k` kind off this block and the canary
+        # gate keys its real-rows identity off it. Absent for configs
+        # outside the zoo axes (tabular etc.) — those serve as before.
+        from gan_deeplearning4j_tpu.zoo.manifest import scenario_from_config
+
+        scenario = scenario_from_config(cfg)
+        if scenario is not None:
+            manifest["zoo"] = scenario.to_dict()
         fd, tmp = _tempfile.mkstemp(dir=directory, suffix=".tmp")
         try:
             with os.fdopen(fd, "w") as fh:
@@ -1096,8 +1152,19 @@ class GanExperiment:
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                return jax.device_put(state, NamedSharding(self.mesh, PartitionSpec()))
-            return state
+                state = jax.device_put(
+                    state, NamedSharding(self.mesh, PartitionSpec()))
+            # Re-own every restored leaf through a compiled multiply-by-one
+            # before any donating step sees it: on CPU the implicit transfer
+            # of a checkpoint's numpy array can be zero-copy, so a donated
+            # buffer aliases memory the runtime does not own and freeing it
+            # corrupts the glibc heap a few allocations later (replicated
+            # device_put over virtual host-platform devices carries the same
+            # hazard). A real compute op forces fresh executable-owned
+            # output allocations — jnp.copy lowers to an elidable alias,
+            # which does NOT; x*1 is bit-exact.
+            return jax.jit(lambda s: jax.tree_util.tree_map(
+                lambda a: a * 1, s))(state)
 
         def _stored(state, trainer=None):
             # checkpoints written under bf16 storage restore as bf16 already
@@ -1144,18 +1211,13 @@ class GanExperiment:
         self.batch_counter = int(self.gan_state.step)
         return self.batch_counter
 
-    def _load_models_sharded(self, directory: str, shard_files: List[str],
-                             stored) -> int:
-        """Reassemble a mesh generation: merge every shard's flat arrays
-        (disjoint by construction, verified here), check the union covers
-        the writer's full key count, and rebuild each TrainState onto this
-        experiment's live trainers. ``stored`` is the caller's
-        cast-and-place closure so sharded and whole-file restores go
-        through one placement path."""
-        from gan_deeplearning4j_tpu.utils.serializer import (
-            _unflatten,
-            read_state_shard,
-        )
+    @staticmethod
+    def _merged_shard_state(directory: str, shard_files: List[str]) -> Dict:
+        """Merge a mesh generation's shard files into one flat state dict,
+        verifying disjointness, completeness, and a consistent shard_count —
+        the model-agnostic half of a sharded restore (the WGAN-GP experiment
+        reassembles its own states from the same merge)."""
+        from gan_deeplearning4j_tpu.utils.serializer import read_state_shard
 
         counts = set()
         indices = []
@@ -1185,6 +1247,19 @@ class GanExperiment:
             raise ValueError(
                 f"mesh generation torn: merged {len(flat)} keys, writer "
                 f"recorded {total_keys}")
+        return flat
+
+    def _load_models_sharded(self, directory: str, shard_files: List[str],
+                             stored) -> int:
+        """Reassemble a mesh generation: merge every shard's flat arrays
+        (disjoint by construction, verified here), check the union covers
+        the writer's full key count, and rebuild each TrainState onto this
+        experiment's live trainers. ``stored`` is the caller's
+        cast-and-place closure so sharded and whole-file restores go
+        through one placement path."""
+        from gan_deeplearning4j_tpu.utils.serializer import _unflatten
+
+        flat = self._merged_shard_state(directory, shard_files)
 
         def train_state(model: str, trainer) -> TrainState:
             params = _unflatten(flat, f"{model}/params")

@@ -24,6 +24,7 @@ import numpy as np
 
 from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
 from gan_deeplearning4j_tpu.harness.experiment import (
+    _MESH_SHARD_RE,
     GanExperiment,
     cost_analysis_dict,
     latent_grid,
@@ -86,7 +87,16 @@ class WganGpExperiment(GanExperiment):
         self._gen_fwd = jax.jit(
             lambda p, z: self.trainer.generator.output(p, z, train=False)
         )
-        self._key = jax.random.PRNGKey(cfg.seed + 2)
+        # serving-publish surface: the inherited publish_for_serving writes
+        # ``self.gen``/``self.gen_params`` (generator-only bundle, cv=None)
+        self.gen = self.trainer.generator
+        # Per-round RNG is DERIVED, not carried: fold_in(base, gen step).
+        # The generator steps exactly once per round, so every round gets a
+        # distinct key — and a resumed run (gen step restored from the
+        # checkpoint) replays the identical key stream, which is what makes
+        # supervisor resume bit-exact (tests/test_zoo.py). A carried
+        # split-per-round key would never be checkpointed and diverge.
+        self._base_key = jax.random.PRNGKey(cfg.seed + 2)
         self._z_grid = latent_grid(cfg.latent_grid, self.model_cfg.z_size)
 
         self.timer = PhaseTimer()
@@ -122,7 +132,7 @@ class WganGpExperiment(GanExperiment):
             b = (b // n) * n
             real = real[:b]
         batches = real.reshape(n, b // n, -1)
-        self._key, sub = jax.random.split(self._key)
+        sub = jax.random.fold_in(self._base_key, int(self.gen_state.step))
         with self.timer.phase("train_round"):
             self.critic_state, self.gen_state, c_loss, g_loss = self.trainer.train_round(
                 self.critic_state, self.gen_state, batches, sub
@@ -151,7 +161,9 @@ class WganGpExperiment(GanExperiment):
                 b = (b // n) * n
                 rounds = rounds[:, :b]
             rounds = rounds.reshape(k, n, b // n, -1)
-            self._key, sub = jax.random.split(self._key)
+            # same derivation as the sequential round: keyed off the gen
+            # step at window entry (the scan folds per-round on top)
+            sub = jax.random.fold_in(self._base_key, int(self.gen_state.step))
             with self.timer.phase("train_rounds"):
                 (
                     self.critic_state,
@@ -212,28 +224,74 @@ class WganGpExperiment(GanExperiment):
         return np.asarray(out)
 
     # -- checkpointing --------------------------------------------------
-    def save_models(self) -> List[str]:
+    def save_models(self, directory: Optional[str] = None) -> List[str]:
         """Critic + generator zips with updater state, same format/cadence as
-        the four-model save (ModelSerializer analog)."""
+        the four-model save (ModelSerializer analog). ``directory`` overrides
+        ``config.output_dir`` — the resilience store's publish callback
+        writes through it, same contract as GanExperiment.save_models."""
         cfg = self.config
-        os.makedirs(cfg.output_dir, exist_ok=True)
+        directory = directory or cfg.output_dir
+        os.makedirs(directory, exist_ok=True)
         paths = []
         for name, graph, state in (
             ("critic", self.trainer.critic, self.critic_state),
             ("gen", self.trainer.generator, self.gen_state),
         ):
-            path = os.path.join(cfg.output_dir, f"{cfg.file_prefix}_{name}_model.zip")
+            path = os.path.join(directory, f"{cfg.file_prefix}_{name}_model.zip")
             write_model(path, graph, state, save_updater=True)
             paths.append(path)
         return paths
 
+    # -- supervisor / mesh-publish surface (resilience/supervisor.py) ----
+    def _publish_step(self) -> int:
+        # no stacked gan graph; the generator steps once per round
+        return int(self.gen_state.step)
+
+    def digest_states(self) -> Dict:
+        """Canonical states for bit-exactness digests — the supervisor's
+        restore-verification contract (both carried states are plain trees,
+        no update sharding here, so no tree-form conversion is needed)."""
+        return {"critic": self.critic_state, "gen": self.gen_state}
+
+    def _flat_state(self) -> Dict:
+        """Flat ``<model>/{params|updater|step}`` namespace for the mesh
+        checkpoint plane — same shape as GanExperiment._flat_state, with the
+        WGAN pair in place of the four-graph protocol (the generator here
+        carries updater state: it is a trained model, not a frozen sampler)."""
+        from gan_deeplearning4j_tpu.utils.serializer import _flatten
+
+        flat: Dict = {}
+        for name, state in (("critic", self.critic_state),
+                            ("gen", self.gen_state)):
+            _flatten(f"{name}/params", state.params, flat)
+            _flatten(f"{name}/updater", state.opt_state, flat)
+            flat[f"{name}/step"] = state.step
+        return flat
+
+    def _load_models_sharded(self, directory: str, shard_files: List[str],
+                             stored) -> int:
+        from gan_deeplearning4j_tpu.utils.serializer import _unflatten
+
+        flat = self._merged_shard_state(directory, shard_files)
+
+        def train_state(model: str) -> TrainState:
+            return TrainState(
+                _unflatten(flat, f"{model}/params"),
+                _unflatten(flat, f"{model}/updater"),
+                jnp.asarray(int(np.asarray(flat[f"{model}/step"])), jnp.int32),
+            )
+
+        self.critic_state = stored(train_state("critic"))
+        self.gen_state = stored(train_state("gen"))
+        self.batch_counter = int(self.gen_state.step)
+        return self.batch_counter
+
     def load_models(self, directory: Optional[str] = None) -> int:
         cfg = self.config
-        prefix = os.path.join(directory or cfg.output_dir, cfg.file_prefix)
+        directory = directory or cfg.output_dir
+        prefix = os.path.join(directory, cfg.file_prefix)
 
-        def _state(path: str) -> TrainState:
-            _, params, opt_state, step = read_model(path)
-            st = TrainState(params, opt_state, jnp.asarray(step, jnp.int32))
+        def _stored(st: TrainState) -> TrainState:
             if self._param_dtype is not None:
                 st = self._cast_state(st)
             if self.mesh is not None:
@@ -241,7 +299,30 @@ class WganGpExperiment(GanExperiment):
                     st,
                     jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()),
                 )
-            return st
+            # Re-own every restored leaf through a compiled multiply-by-one
+            # BEFORE the train round's donation sees it: on CPU the implicit
+            # transfer of a checkpoint's numpy array can be zero-copy, so
+            # the donated buffer aliases memory the runtime does not own and
+            # freeing it corrupts the glibc heap a few allocations later
+            # (replicated device_put over virtual host-platform devices
+            # carries the same hazard). A real compute op forces fresh
+            # executable-owned output allocations — jnp.copy lowers to an
+            # elidable alias, which does NOT; x*1 is bit-exact.
+            return jax.jit(lambda s: jax.tree_util.tree_map(
+                lambda a: a * 1, s))(st)
+
+        # elastic mesh restore: a generation of *_state_shard-K-of-M.zip
+        # files merges back regardless of M, same contract as GanExperiment
+        shard_files = sorted(
+            n for n in os.listdir(directory)
+            if _MESH_SHARD_RE.search(n) and n.startswith(cfg.file_prefix)
+        )
+        if shard_files:
+            return self._load_models_sharded(directory, shard_files, _stored)
+
+        def _state(path: str) -> TrainState:
+            _, params, opt_state, step = read_model(path)
+            return _stored(TrainState(params, opt_state, jnp.asarray(step, jnp.int32)))
 
         self.critic_state = _state(f"{prefix}_critic_model.zip")
         self.gen_state = _state(f"{prefix}_gen_model.zip")

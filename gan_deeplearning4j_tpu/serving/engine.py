@@ -124,6 +124,7 @@ class ServingEngine:
         export_gauge: bool = True,
         staging_pool=None,
         precision: Optional[str] = None,
+        scenario: Optional[dict] = None,
     ):
         import jax
 
@@ -131,6 +132,13 @@ class ServingEngine:
 
         if not models:
             raise ValueError("ServingEngine needs at least one model")
+        #: the bundle manifest's zoo scenario block (zoo/manifest.py) —
+        #: declares dataset identity and conditioning. A conditional
+        #: scenario makes ``sample?class=k`` legal (serving/service.py
+        #: appends the one-hot embedding); None = pre-zoo bundle,
+        #: unconditional. Kept as the raw dict so the engine layer has no
+        #: zoo import; ``scenario_manifest()`` parses on demand.
+        self.scenario = dict(scenario) if scenario else None
         #: store generation of the loaded bundle (None for bare-checkpoint
         #: loads) — the version the reload plane keys on; /healthz and
         #: /metrics surface it so an operator can see WHICH model serves
@@ -198,6 +206,14 @@ class ServingEngine:
             kind: self._graphs[role].input_types[0].features
             for kind, (role, _) in self._kinds.items()
         }
+        if self.conditional and "sample" in self._in_width:
+            declared = int(self.scenario.get("z_size", 0)) + self.class_count
+            if self._in_width["sample"] != declared:
+                raise ValueError(
+                    f"conditional bundle declares z_size+classes = {declared} "
+                    f"but the generator takes {self._in_width['sample']} "
+                    f"inputs — manifest and checkpoint disagree"
+                )
         self._compiled: Dict[Tuple[str, int, int], object] = {}
         self._bulk: Dict[str, object] = {}  # kind -> mesh-sharded executable
         self._params_mesh: Dict[str, object] = {}
@@ -287,6 +303,7 @@ class ServingEngine:
         export_gauge: bool = True,
         staging_pool=None,
         precision: Optional[str] = None,
+        scenario: Optional[dict] = None,
     ) -> "ServingEngine":
         """Restore from serializer checkpoint zips. Updater state is never
         loaded — a serving replica has no optimizer."""
@@ -303,7 +320,7 @@ class ServingEngine:
         return cls(models, buckets=buckets, feature_vertex=feature_vertex,
                    replicas=replicas, generation=generation,
                    export_gauge=export_gauge, staging_pool=staging_pool,
-                   precision=precision)
+                   precision=precision, scenario=scenario)
 
     @classmethod
     def from_bundle(
@@ -348,6 +365,7 @@ class ServingEngine:
             export_gauge=export_gauge,
             staging_pool=staging_pool,
             precision=manifest.get("precision"),
+            scenario=manifest.get("zoo"),
         )
 
     # -- introspection ------------------------------------------------------
@@ -373,6 +391,40 @@ class ServingEngine:
 
     def input_width(self, kind: str) -> int:
         return self._in_width[kind]
+
+    # -- zoo scenario surface (docs/ZOO.md) ---------------------------------
+    @property
+    def conditional(self) -> bool:
+        """Whether this bundle's manifest declares class conditioning —
+        the gate for ``sample?class=k`` (service layer). Pre-zoo bundles
+        (scenario None) are unconditional."""
+        return bool(self.scenario) and self.scenario.get("conditioning") == "class"
+
+    @property
+    def class_count(self) -> int:
+        """Number of condition classes (0 when unconditional)."""
+        return int(self.scenario.get("num_classes", 0)) if self.conditional else 0
+
+    def latent_width(self, kind: str) -> int:
+        """The CALLER-facing latent width of a kind: the full input width
+        minus the one-hot class block the service appends for conditional
+        ``sample?class=k`` requests. Equals ``input_width`` for every
+        unconditional kind — classify/features inputs are real rows, not
+        latents, so only ``sample`` ever differs."""
+        width = self._in_width[kind]
+        if kind == "sample" and self.conditional:
+            return width - self.class_count
+        return width
+
+    def scenario_manifest(self):
+        """The parsed :class:`~gan_deeplearning4j_tpu.zoo.manifest.
+        ScenarioManifest` (None for pre-zoo bundles). Lazy import — the
+        engine stores the raw dict so serving has no hard zoo dependency."""
+        if self.scenario is None:
+            return None
+        from gan_deeplearning4j_tpu.zoo.manifest import ScenarioManifest
+
+        return ScenarioManifest.from_dict(self.scenario)
 
     @property
     def replica_count(self) -> int:

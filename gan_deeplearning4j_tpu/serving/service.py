@@ -158,6 +158,11 @@ class InferenceService:
             # None when the engine was loaded from bare checkpoints
             "generation": engine.generation,
         }
+        if engine.scenario is not None:
+            # the bundle's zoo identity (docs/ZOO.md) — lets an operator
+            # (and the zoo drill) see which scenario serves without
+            # reading the bundle manifest off disk
+            body["scenario"] = dict(engine.scenario)
         if self.reloader is not None:
             # candidate state (idle/warming/canary/swapping/rejected), swap
             # and rejection counts — the reload plane's liveness surface
@@ -290,6 +295,59 @@ class InferenceService:
                 return 400, {"status": "error", "error": f"bad 'data': {exc}"}
             if rows.ndim == 1:
                 rows = rows[None, :]
+            # conditional sampling (docs/ZOO.md): ``/v1/sample?class=k``
+            # takes BASE-z rows and appends the one-hot class embedding
+            # here, so the widened rows flow through the existing width
+            # check, batcher, and AOT bucket ladder untouched — zero new
+            # compile surface. Unconditional bundles 400 the parameter.
+            cls_param = params.get("class", [None])[0]
+            if cls_param is not None:
+                if kind != "sample":
+                    return 400, {"status": "error",
+                                 "error": f"?class= applies to the sample "
+                                          f"kind, not {kind!r}"}
+                if not engine.conditional:
+                    return 400, {"status": "error",
+                                 "error": "this bundle is unconditional — "
+                                          "its manifest declares no class "
+                                          "conditioning"}
+                try:
+                    label = int(cls_param)
+                except ValueError:
+                    return 400, {"status": "error",
+                                 "error": f"bad 'class': {cls_param!r}"}
+                if not 0 <= label < engine.class_count:
+                    return 400, {
+                        "status": "error",
+                        "error": f"class {label} out of range "
+                                 f"[0, {engine.class_count})",
+                    }
+                latent = engine.latent_width(kind)
+                if rows.ndim != 2 or rows.shape[0] < 1 or rows.shape[1] != latent:
+                    return 400, {
+                        "status": "error",
+                        "error": f"{kind}?class={label}: expected "
+                                 f"(n >= 1, {latent}) latent rows, "
+                                 f"got {tuple(rows.shape)}",
+                    }
+                onehot = np.zeros(
+                    (rows.shape[0], engine.class_count), dtype=np.float32)
+                onehot[:, label] = 1.0
+                rows = np.concatenate([rows, onehot], axis=1)
+            elif kind == "sample" and engine.conditional:
+                # a conditional bundle still serves UNCONDITIONAL full-width
+                # rows (caller supplies its own embedding) — the drills'
+                # parity oracle and the mux plane's model-pinned probes rely
+                # on this — but a bare latent-width row without ?class= is
+                # a caller error worth a precise message
+                if rows.ndim == 2 and rows.shape[1] == engine.latent_width(kind):
+                    return 400, {
+                        "status": "error",
+                        "error": f"sample: got {rows.shape[1]}-wide latent "
+                                 f"rows without ?class=k — pass ?class= or "
+                                 f"supply full {engine.input_width(kind)}-"
+                                 f"wide rows with the embedding",
+                    }
             width = engine.input_width(kind)
             # reject malformed shapes HERE: a bad row must 400 its own
             # request, never reach the shared batch and error its riders

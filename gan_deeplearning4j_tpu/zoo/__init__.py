@@ -1,0 +1,27 @@
+"""Model zoo — manifest-driven scenarios beyond unconditional MNIST-DCGAN.
+
+See docs/ZOO.md. The manifest (:mod:`zoo.manifest`) is the single scenario
+descriptor the harness, serializer, serving engine, canary gate, and mux
+drills all key off; :mod:`zoo.datasets` holds the per-dataset loaders and
+:mod:`zoo.streaming` the double-buffered input pipeline.
+"""
+
+from gan_deeplearning4j_tpu.zoo.manifest import (
+    ARCHITECTURES,
+    CONDITIONINGS,
+    DATASET_SHAPES,
+    DATASETS,
+    ScenarioManifest,
+    scenario_from_bundle,
+    scenario_from_config,
+)
+
+__all__ = [
+    "ARCHITECTURES",
+    "CONDITIONINGS",
+    "DATASETS",
+    "DATASET_SHAPES",
+    "ScenarioManifest",
+    "scenario_from_bundle",
+    "scenario_from_config",
+]

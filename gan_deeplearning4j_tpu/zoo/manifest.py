@@ -1,0 +1,216 @@
+"""ScenarioManifest — the model zoo's one typed scenario descriptor.
+
+Everything downstream of training keys off this object instead of assuming
+unconditional MNIST-DCGAN (ROADMAP "Scenario diversity"): the harness builds
+its ``ExperimentConfig`` from it, the serializer embeds it in a bundle's
+``serving.json`` under the ``"zoo"`` key, the serving engine reads it back to
+decide whether ``POST /v1/sample?class=k`` is legal, and the canary gate
+refuses to FID-score a candidate against reals of a different dataset.
+
+The axes (docs/ZOO.md):
+
+- ``architecture``: ``"dcgan"`` (the reference's alternating XENT loop,
+  GraphTrainer families) or ``"wgan_gp"`` (critic-round program,
+  models/wgan_gp.py).
+- ``conditioning``: ``"none"`` or ``"class"`` — class-conditional widens the
+  generator input to ``[z | one-hot(class)]`` (harness/experiment.py); the
+  discriminator stays unconditional so the paper's transfer claim is
+  untouched.
+- ``dataset``: ``"mnist"`` | ``"fashion_mnist"`` | ``"cifar_shaped"`` — the
+  identity of the real rows (zoo/datasets.py loaders). Resolution is
+  dataset-native and validated, not free.
+
+Validation encodes the real architectural constraints rather than wishful
+ones: the image/WGAN-GP stem uses ``stages_for(height, width)`` which
+requires power-of-two sides, so ``wgan_gp`` only builds at the 32×32
+``cifar_shaped`` dataset; MNIST-shaped 28×28 datasets map to the proven
+"mnist" DCGAN family. ``wgan_gp`` + ``conditioning='class'`` is rejected
+(queued in ROADMAP.md) — config.py enforces the same pair server-side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+ARCHITECTURES = ("dcgan", "wgan_gp")
+CONDITIONINGS = ("none", "class")
+DATASETS = ("mnist", "fashion_mnist", "cifar_shaped")
+
+# dataset -> (height, width, channels): the native shape of its real rows.
+# Resolution is NOT a free axis — a scenario's ``resolution`` must equal the
+# native side (square datasets only), which keeps "resolution" in the
+# manifest as documentation of the serving surface rather than a second
+# source of truth that could drift from the loader.
+DATASET_SHAPES: Dict[str, tuple] = {
+    "mnist": (28, 28, 1),
+    "fashion_mnist": (28, 28, 1),
+    "cifar_shaped": (32, 32, 3),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioManifest:
+    architecture: str = "dcgan"
+    conditioning: str = "none"
+    dataset: str = "mnist"
+    resolution: int = 28
+    num_classes: int = 10
+    z_size: int = 2
+
+    def __post_init__(self) -> None:
+        if self.architecture not in ARCHITECTURES:
+            raise ValueError(
+                f"unknown architecture {self.architecture!r} "
+                f"(want one of {ARCHITECTURES})"
+            )
+        if self.conditioning not in CONDITIONINGS:
+            raise ValueError(
+                f"unknown conditioning {self.conditioning!r} "
+                f"(want one of {CONDITIONINGS})"
+            )
+        if self.dataset not in DATASETS:
+            raise ValueError(
+                f"unknown dataset {self.dataset!r} (want one of {DATASETS})"
+            )
+        native = DATASET_SHAPES[self.dataset][0]
+        if self.resolution != native:
+            raise ValueError(
+                f"dataset {self.dataset!r} is {native}x{native}; "
+                f"resolution {self.resolution} is not an independent axis"
+            )
+        if self.architecture == "wgan_gp":
+            if self.dataset != "cifar_shaped":
+                # dcgan_image.stages_for requires power-of-two sides: the
+                # 28x28 datasets cannot build the conv stem.
+                raise ValueError(
+                    "wgan_gp's conv stem (stages_for) needs power-of-two "
+                    f"sides — dataset {self.dataset!r} is "
+                    f"{native}x{native}; use dataset='cifar_shaped'"
+                )
+            if self.conditioning == "class":
+                raise ValueError(
+                    "wgan_gp + conditioning='class' is queued (ROADMAP.md); "
+                    "the critic-round program is unconditional"
+                )
+        if self.conditioning == "class" and self.num_classes < 2:
+            raise ValueError(
+                "class-conditional scenarios need num_classes >= 2"
+            )
+        if self.z_size < 1:
+            raise ValueError(f"z_size {self.z_size} must be >= 1")
+
+    # -- derived identities --------------------------------------------------
+    @property
+    def shape(self) -> tuple:
+        """(height, width, channels) of the dataset's rows."""
+        return DATASET_SHAPES[self.dataset]
+
+    @property
+    def num_features(self) -> int:
+        h, w, c = self.shape
+        return h * w * c
+
+    @property
+    def family_name(self) -> str:
+        """The models/registry.py family this scenario trains under."""
+        if self.architecture == "wgan_gp":
+            return "wgan_gp"
+        # dcgan: the 28x28 datasets run the reference's fixed-28x28 MNIST
+        # graph (7*7*128 stem); power-of-two sides run the shape-generic
+        # image family.
+        return "mnist" if self.shape[0] == 28 else "image"
+
+    @property
+    def conditional(self) -> bool:
+        return self.conditioning == "class"
+
+    @property
+    def sample_input_width(self) -> int:
+        """Serving-side ``sample`` kind input width: z, plus the one-hot
+        label embedding for conditional scenarios."""
+        return self.z_size + (self.num_classes if self.conditional else 0)
+
+    # -- config / dict plumbing ----------------------------------------------
+    def experiment_config(self, **overrides: Any):
+        """Materialize an ``ExperimentConfig`` for this scenario.
+
+        Lazy import: harness/config.py validates against the model registry,
+        which must not import zoo/ at module scope (cycle)."""
+        from gan_deeplearning4j_tpu.harness.config import ExperimentConfig
+
+        h, w, c = self.shape
+        base = dict(
+            model_family=self.family_name,
+            conditioning=self.conditioning,
+            dataset=self.dataset,
+            height=h,
+            width=w,
+            channels=c,
+            num_features=h * w * c,
+            num_classes=self.num_classes,
+            z_size=self.z_size,
+        )
+        base.update(overrides)
+        return ExperimentConfig(**base).validate()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(doc: Dict[str, Any]) -> "ScenarioManifest":
+        fields = {f.name for f in dataclasses.fields(ScenarioManifest)}
+        unknown = set(doc) - fields
+        if unknown:
+            raise ValueError(f"unknown scenario manifest keys {sorted(unknown)}")
+        return ScenarioManifest(**doc)
+
+
+def scenario_from_config(cfg) -> Optional[ScenarioManifest]:
+    """Recover the scenario a config trains, or None when the config falls
+    outside the zoo's axes (tabular family, non-native shapes, legacy
+    configs). None means 'publish an unconditional legacy bundle' — never an
+    error: the zoo is additive over the existing single-scenario plane."""
+    from gan_deeplearning4j_tpu.models import registry
+
+    try:
+        family = registry.get(cfg.model_family).name
+    except Exception:
+        return None
+    if family == "wgan_gp":
+        architecture = "wgan_gp"
+    elif family in ("mnist", "image"):
+        architecture = "dcgan"
+    else:
+        return None  # tabular and friends live outside the image zoo
+    dataset = getattr(cfg, "dataset", "mnist")
+    if (cfg.height, cfg.width, cfg.channels) != DATASET_SHAPES.get(dataset):
+        # the config trains some other shape (tiny test configs, legacy
+        # image runs) — an honest manifest must not claim a zoo dataset
+        # whose native shape the model doesn't actually have
+        return None
+    try:
+        return ScenarioManifest(
+            architecture=architecture,
+            conditioning=getattr(cfg, "conditioning", "none"),
+            dataset=dataset,
+            resolution=DATASET_SHAPES.get(dataset, (cfg.height,))[0],
+            num_classes=cfg.num_classes,
+            z_size=cfg.z_size,
+        )
+    except (ValueError, KeyError):
+        return None
+
+
+def scenario_from_bundle(directory: str) -> Optional[ScenarioManifest]:
+    """Read the scenario block out of a serving bundle's manifest.
+
+    Returns None for pre-zoo bundles (no ``"zoo"`` key) — those serve as
+    before: unconditional, MNIST-assumed."""
+    path = os.path.join(directory, "serving.json")
+    with open(path) as fh:
+        manifest = json.load(fh)
+    doc = manifest.get("zoo")
+    return None if doc is None else ScenarioManifest.from_dict(doc)

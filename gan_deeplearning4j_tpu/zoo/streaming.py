@@ -1,0 +1,194 @@
+"""Streaming input pipeline — host-side double buffering behind the
+DataSetIterator contract (docs/ZOO.md, the TensorFlow-system input-pipeline
+pattern from PAPERS.md).
+
+``ArrayDataSetIterator`` holds the whole dataset as one resident float32
+matrix; fine for MNIST, wrong as the zoo adds datasets whose size should not
+be coupled to the step loop. ``StreamingDataSetIterator`` keeps only two
+BLOCKS resident (a block is ``block_batches`` batches): the consumer slices
+batches out of the current block while a single background worker
+materializes the next block from the row ``source``. The promotion FENCES on
+the worker's future before the consumer ever reads the incoming buffer — the
+exact discipline jaxlint JG032 (double-buffer-misuse) enforces statically.
+
+Bit-exactness is the contract, not an aspiration: epoch order is the same
+seeded permutation (``default_rng(seed + epoch)``), rows are cast to float32
+the same way, and batches are the same ``order[cursor:cursor+batch_size]``
+slices — so at matched seed the streamed batches are byte-identical to the
+in-memory iterator's (tests/test_zoo.py proves it). Training through it is
+therefore a data-plane swap with zero step-loop changes.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from gan_deeplearning4j_tpu.data.dataset import DataSet
+from gan_deeplearning4j_tpu.data.iterator import DataSetIterator
+
+# source(indices) -> (feature_rows, label_rows | None) for the given global
+# row indices, in order. Called from the prefetch worker thread — must be
+# thread-compatible (pure reads).
+RowSource = Callable[[np.ndarray], Tuple[np.ndarray, Optional[np.ndarray]]]
+
+
+def array_source(
+    features: np.ndarray, labels: Optional[np.ndarray] = None
+) -> Tuple[RowSource, int]:
+    """Adapt in-memory arrays to the row-source contract. The float32 cast
+    happens HERE, once — mirroring ArrayDataSetIterator's constructor cast —
+    so streamed rows are bit-identical to the in-memory iterator's."""
+    feats = np.asarray(features, dtype=np.float32)
+    labs = None if labels is None else np.asarray(labels, dtype=np.float32)
+    if labs is not None and labs.shape[0] != feats.shape[0]:
+        raise ValueError("features/labels row mismatch")
+
+    def source(idx: np.ndarray):
+        return feats[idx], (None if labs is None else labs[idx])
+
+    return source, feats.shape[0]
+
+
+def npz_source(
+    path: str, features_key: str = "features", labels_key: str = "labels"
+) -> Tuple[RowSource, int]:
+    """Row source over an ``.npz`` file (the drills' workload format). The
+    file is opened once; row gathers run in the prefetch worker, so the
+    consumer thread never touches the file."""
+    archive = np.load(path)
+    feats = np.asarray(archive[features_key], dtype=np.float32)
+    labs = (
+        np.asarray(archive[labels_key], dtype=np.float32)
+        if labels_key in archive.files
+        else None
+    )
+    return array_source(feats, labs)
+
+
+class StreamingDataSetIterator(DataSetIterator):
+    """Double-buffered DataSetIterator over a row source.
+
+    Two buffers: the CURRENT block (being consumed batch-by-batch) and the
+    PENDING block (being filled by the worker). ``_promote`` is the only
+    place the pending buffer becomes readable, and it calls
+    ``Future.result()`` first — the fence. (It is a promotion, not a
+    concurrent swap seam: the consumer is single-threaded and the worker
+    never touches ``_block``, so no lock is needed — which is also why the
+    method is not named ``swap``; JG016's lock discipline is for engines
+    hot-swapped under other threads.) Blocks are batch-aligned (``block_batches *
+    batch_size`` rows), so no batch ever straddles a buffer boundary; the
+    ragged tail (``drop_remainder=False``) is simply the last block's short
+    final slice, same as the in-memory iterator.
+    """
+
+    def __init__(
+        self,
+        source: RowSource,
+        num_rows: int,
+        batch_size: int = 128,
+        shuffle: bool = False,
+        seed: int = 666,
+        drop_remainder: bool = False,
+        block_batches: int = 8,
+    ):
+        if block_batches < 1:
+            raise ValueError("block_batches must be >= 1")
+        self._source = source
+        self.num_rows = int(num_rows)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self._block_rows = block_batches * self.batch_size
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="zoo-stream"
+        )
+        self._epoch = 0
+        self._start_epoch()
+
+    # -- epoch / block machinery ---------------------------------------------
+    def _make_order(self) -> np.ndarray:
+        # Identical to ArrayDataSetIterator._make_order: that identity IS the
+        # bit-exactness guarantee.
+        if not self.shuffle:
+            return np.arange(self.num_rows)
+        rng = np.random.default_rng(self.seed + self._epoch)
+        return rng.permutation(self.num_rows)
+
+    def _start_epoch(self) -> None:
+        self._order = self._make_order()
+        self._cursor = 0
+        self._block: Optional[Tuple[int, np.ndarray, Optional[np.ndarray]]] = None
+        self._pending: Optional[Tuple[int, Future]] = None
+        self._issue(0)
+        self._promote()
+
+    def _materialize(self, idx: np.ndarray):
+        feats, labs = self._source(idx)
+        feats = np.asarray(feats, dtype=np.float32)
+        labs = None if labs is None else np.asarray(labs, dtype=np.float32)
+        return feats, labs
+
+    def _issue(self, start: int) -> None:
+        """Kick off the overlapped fill of the block starting at ``start``."""
+        if start >= len(self._order):
+            self._pending = None
+            return
+        idx = self._order[start : start + self._block_rows]
+        self._pending = (start, self._executor.submit(self._materialize, idx))
+
+    def _promote(self) -> None:
+        """Promote the pending buffer to current. The ``result()`` call is
+        the FENCE: the consumer must never read a buffer whose fill is still
+        in flight (jaxlint JG032)."""
+        if self._pending is None:
+            self._block = None
+            return
+        start, future = self._pending
+        feats, labs = future.result()
+        self._block = (start, feats, labs)
+        self._issue(start + len(feats))
+
+    # -- DataSetIterator protocol --------------------------------------------
+    def has_next(self) -> bool:
+        remaining = self.num_rows - self._cursor
+        if self.drop_remainder:
+            return remaining >= self.batch_size
+        return remaining > 0
+
+    def next(self) -> DataSet:
+        if not self.has_next() or self._block is None:
+            raise StopIteration
+        start, feats, labs = self._block
+        offset = self._cursor - start
+        rows = feats[offset : offset + self.batch_size]
+        self._cursor += len(rows)
+        batch = DataSet(
+            jnp.asarray(rows),
+            None if labs is None else jnp.asarray(labs[offset : offset + len(rows)]),
+        )
+        if self._cursor >= start + len(feats):
+            self._promote()
+        return batch
+
+    def reset(self) -> None:
+        # Fence any in-flight fill before discarding its target buffer, then
+        # rebuild the epoch order (epoch increments first, matching
+        # ArrayDataSetIterator.reset's permutation schedule).
+        if self._pending is not None:
+            self._pending[1].result()
+            self._pending = None
+        self._epoch += 1
+        self._start_epoch()
+
+    def close(self) -> None:
+        """Release the worker thread. Safe to call more than once; the
+        iterator is unusable afterwards."""
+        if self._pending is not None:
+            self._pending[1].result()
+            self._pending = None
+        self._executor.shutdown(wait=True)

@@ -163,6 +163,21 @@ SPEC = {
                ["phases.learned.lost", "phases.baseline.lost"],
                "lower", 0.0, max_abs=0),
     ],
+    "zoo": [
+        # the conditional serving contract: every class must round-trip
+        # staged == host, and after warmup the compile ledger must not
+        # move — conditioning is a data change, never a compile surface
+        Metric("parity_classes", "results.conditional.parity_classes",
+               "info"),
+        Metric("serve_compiles",
+               "results.conditional.serve_compiles_total", "lower", 0.0,
+               max_abs=0),
+        # the mux exactly-one-answer ledger across two architecture-
+        # distinct zoo variants (dcgan-mnist vs wgan_gp-cifar)
+        Metric("mux_errors", "results.mux.errors", "lower", 0.0,
+               max_abs=0),
+        Metric("mux_lost", "results.mux.lost", "lower", 0.0, max_abs=0),
+    ],
     "train": [],  # raw bench dumps: invariants/ok gating only
 }
 

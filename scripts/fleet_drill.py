@@ -1365,6 +1365,73 @@ def run_mux(args) -> int:
             f"{heavy_sheds:.0f}, lite sheds {lite_sheds:.0f}, "
             f"lite ok during {lite_ok_during:.0f}")
 
+        # -- phase 5: manifest-built conditional variant ------------------
+        # the zoo seam (docs/ZOO.md): a variant built FROM a scenario
+        # manifest — conditional dcgan-mnist, a genuinely different
+        # architecture (28×28 conv generator, latent+one-hot input) than
+        # the tabular drill variants — joins the SAME registry. The
+        # publish goes through the real experiment path so serving.json
+        # carries the zoo block; the engine the registry builds from the
+        # bundle is conditional end to end, and the mux width check
+        # scopes to the ROUTED variant: full-width rows pinned to it
+        # serve while latent-width rows 400 without touching the
+        # tabular variants' contracts.
+        from gan_deeplearning4j_tpu.harness import GanExperiment
+        from gan_deeplearning4j_tpu.zoo.manifest import ScenarioManifest
+
+        scn = ScenarioManifest(
+            architecture="dcgan", conditioning="class", dataset="mnist",
+            resolution=28, num_classes=10, z_size=z_size)
+        cond_dir = os.path.join(workdir, "variant_cond")
+        GanExperiment(scn.experiment_config(seed=args.seed + 41)
+                      ).publish_for_serving(cond_dir)
+        # price it on the ladder it will serve (a variable, not a
+        # literal — JG031) so it enters the registry already measured
+        measure_bundle_cost(cond_dir, buckets=drill_buckets, rounds=2)
+        registry.add("cond", bundle_path=cond_dir, cost=2.0, weight=0.0)
+        registry.ensure_resident("cond")
+        cond_engine = registry.engine_for("cond")
+        cond_width = cond_engine.input_width("sample")
+        heavy_width = registry.engine_for("heavy").input_width("sample")
+        rng = np.random.default_rng(args.seed + 42)
+        zc = rng.random((5, cond_width - 10), dtype=np.float32) * 2.0 - 1.0
+        onehot = np.eye(10, dtype=np.float32)[np.arange(5) % 10]
+        full_rows = np.concatenate([zc, onehot], axis=1)
+        st_full, body_full = http_json(
+            "POST", f"{base}/v1/sample",
+            {"data": full_rows.tolist(), "model": "cond"}, timeout=30.0)
+        st_narrow, _ = http_json(
+            "POST", f"{base}/v1/sample",
+            {"data": zc.tolist(), "model": "cond"}, timeout=30.0)
+        cond_costs = registry.costs()
+        results["conditional_variant"] = {
+            "scenario": dict(cond_engine.scenario or {}),
+            "input_width": cond_width,
+            "tabular_input_width": heavy_width,
+            "pinned_full_width_status": st_full,
+            "pinned_latent_width_status": st_narrow,
+            "cost": cond_costs.get("cond"),
+            "cost_source": registry.cost_sources().get("cond"),
+        }
+        invariants["conditional_variant_manifest_built"] = (
+            bool(cond_engine.conditional)
+            and cond_engine.class_count == 10
+            and (cond_engine.scenario or {}).get("dataset") == "mnist")
+        invariants["conditional_enters_measured"] = (
+            registry.cost_sources().get("cond") == "measured")
+        invariants["conditional_pinned_serves_full_width"] = (
+            st_full == 200
+            and len((body_full or {}).get("data", [])) == 5)
+        invariants["conditional_width_guard_rejects_latent"] = (
+            st_narrow == 400)
+        invariants["conditional_architecture_distinct"] = (
+            cond_width != heavy_width
+            and cond_costs.get("cond") != cond_costs.get("heavy"))
+        log(f"conditional variant: width {cond_width} (tabular "
+            f"{heavy_width}), pinned full-width -> {st_full}, "
+            f"latent-width -> {st_narrow}, cost "
+            f"{cond_costs.get('cond'):.3g} ({results['conditional_variant']['cost_source']})")
+
         # -- ledger -------------------------------------------------------
         final = load.finish()
         results["ledger"] = final

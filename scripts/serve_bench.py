@@ -69,11 +69,19 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
-def build_bundle(directory: str, seed: int = 666) -> dict:
+def build_bundle(directory: str, seed: int = 666, classes: int = 0) -> dict:
     """Fresh (untrained) MNIST artifacts through the REAL publish path:
     build graphs, then write serving checkpoints + manifest with
     ``write_model`` — the bench exercises the same loader a trained bundle
-    would hit, and weights don't change the serving-layer physics."""
+    would hit, and weights don't change the serving-layer physics.
+
+    ``classes > 0`` builds the CONDITIONAL variant (docs/ZOO.md): the
+    generator's input grows by the one-hot label block exactly as the
+    conditional trainer builds it, and the returned ``scenario`` dict is
+    the zoo manifest block a conditional bundle would declare — so the
+    engine the bench loads is conditional end to end."""
+    import dataclasses
+
     from gan_deeplearning4j_tpu.harness import ExperimentConfig
     from gan_deeplearning4j_tpu.models import registry
     from gan_deeplearning4j_tpu.utils import write_model
@@ -82,7 +90,19 @@ def build_bundle(directory: str, seed: int = 666) -> dict:
     family = registry.get("mnist")
     model_cfg = family.make_model_config(cfg)
     dis = family.build_discriminator(model_cfg)
-    gen = family.build_generator(model_cfg)
+    gen_cfg = model_cfg
+    scenario = None
+    if classes > 0:
+        gen_cfg = dataclasses.replace(
+            model_cfg, z_size=model_cfg.z_size + classes)
+        from gan_deeplearning4j_tpu.zoo.manifest import ScenarioManifest
+
+        scenario = ScenarioManifest(
+            architecture="dcgan", conditioning="class", dataset="mnist",
+            resolution=cfg.height, num_classes=classes,
+            z_size=model_cfg.z_size,
+        ).to_dict()
+    gen = family.build_generator(gen_cfg)
     dis_params = dis.init()
     cv, cv_params = family.build_transfer_classifier(dis, dis_params, model_cfg)
     gen_path = os.path.join(directory, "bench_gen_serving.zip")
@@ -95,14 +115,20 @@ def build_bundle(directory: str, seed: int = 666) -> dict:
         "feature_vertex": list(family.dis_to_cv.values())[-1],
         "z_size": model_cfg.z_size,
         "num_features": cfg.num_features,
+        "classes": classes,
+        "scenario": scenario,
     }
 
 
 def _drive(service, kinds, width, sizes, requests, threads, seed,
-           timeout=None):
+           timeout=None, classes=0):
     """Closed-loop phase: ``threads`` clients loop submit→wait→submit.
     Returns (statuses, rows_done, elapsed) — one status per request, the
-    zero-lost ledger."""
+    zero-lost ledger. ``classes > 0`` drives the conditional sample kind:
+    the last ``classes`` columns of each sample row are a real one-hot
+    label block (random class per row), matching what the HTTP seam's
+    ``?class=k`` appends — the padded buckets see the rows a conditional
+    deployment would actually serve."""
     statuses = []
     lock = threading.Lock()
     per_thread = requests // threads
@@ -113,9 +139,16 @@ def _drive(service, kinds, width, sizes, requests, threads, seed,
         for _ in range(per_thread):
             kind = kinds[rng.integers(len(kinds))]
             n = int(sizes[rng.integers(len(sizes))])
-            rows = rng.random((n, width[kind]), dtype=np.float32)
-            if kind == "sample":
-                rows = rows * 2.0 - 1.0
+            if kind == "sample" and classes > 0:
+                z = rng.random(
+                    (n, width[kind] - classes), dtype=np.float32) * 2.0 - 1.0
+                onehot = np.eye(classes, dtype=np.float32)[
+                    rng.integers(classes, size=n)]
+                rows = np.concatenate([z, onehot], axis=1)
+            else:
+                rows = rng.random((n, width[kind]), dtype=np.float32)
+                if kind == "sample":
+                    rows = rows * 2.0 - 1.0
             res = service.batcher.submit(kind, rows, timeout=timeout)
             with lock:
                 statuses.append(res.status)
@@ -330,7 +363,8 @@ def run_bench(args) -> dict:
     return summary
 
 
-def _replay_phase(engine, args, kinds, width, trace_sizes, threads):
+def _replay_phase(engine, args, kinds, width, trace_sizes, threads,
+                  classes=0):
     """Drive one engine over the trace draws; return its measured side
     of the A/B (waste, latency, compiles, zero-lost ledger)."""
     from gan_deeplearning4j_tpu.serving import InferenceService
@@ -345,7 +379,7 @@ def _replay_phase(engine, args, kinds, width, trace_sizes, threads):
     )
     statuses, rows_ok, elapsed = _drive(
         service, kinds, width, trace_sizes, len(trace_sizes), threads,
-        args.seed,
+        args.seed, classes=classes,
     )
     metrics = service.metrics()
     stats = engine.stats()
@@ -395,6 +429,11 @@ def run_replay(args) -> dict:
     trace_sizes = [int(s) for s in trace.get("sizes", []) if int(s) >= 1]
     if not trace_sizes:
         raise SystemExit(f"replay trace {args.replay} has no sizes")
+    # a conditional trace (docs/ZOO.md) declares the class count; the
+    # replay then builds a conditional bundle and drives full-width
+    # sample rows (latent + one-hot) through the same ladder DP — the
+    # learned ladder is solved from conditional-kind traffic
+    classes = int((trace.get("conditional") or {}).get("classes", 0))
     threads = args.threads
     if args.smoke:
         trace_sizes = trace_sizes[:96]
@@ -410,8 +449,8 @@ def run_replay(args) -> dict:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     with tempfile.TemporaryDirectory() as tmp:
-        bundle = build_bundle(tmp, seed=args.seed)
-        width = {"sample": bundle["z_size"],
+        bundle = build_bundle(tmp, seed=args.seed, classes=classes)
+        width = {"sample": bundle["z_size"] + classes,
                  "classify": bundle["num_features"],
                  "features": bundle["num_features"]}
 
@@ -422,6 +461,7 @@ def run_replay(args) -> dict:
                 buckets=buckets,
                 feature_vertex=bundle["feature_vertex"],
                 replicas=args.replicas,
+                scenario=bundle["scenario"],
             )
 
         # -- calibration + baseline measurement: the incumbent-shaped
@@ -434,7 +474,8 @@ def run_replay(args) -> dict:
         cold_s = time.perf_counter() - t0
         kinds = list(base_engine.kinds)
         flush_counts, baseline_phase = _replay_phase(
-            base_engine, args, kinds, width, trace_sizes, threads)
+            base_engine, args, kinds, width, trace_sizes, threads,
+            classes=classes)
 
         learned = solve_ladder(flush_counts, budget=len(baseline), top=top)
         analytic = {
@@ -448,7 +489,8 @@ def run_replay(args) -> dict:
         engine = build(learned)
         engine.warmup()
         _, learned_phase = _replay_phase(
-            engine, args, kinds, width, trace_sizes, threads)
+            engine, args, kinds, width, trace_sizes, threads,
+            classes=classes)
 
         # -- elasticity: a fresh engine on the ladder the cold pass
         # compiled re-warms from the persistent cache — the same AOT
@@ -483,6 +525,7 @@ def run_replay(args) -> dict:
             "distinct_sizes": len(set(trace_sizes)),
             "threads": threads,
             "replicas": args.replicas,
+            "conditional_classes": classes,
             "smoke": bool(args.smoke),
             "platform": os.environ.get("JAX_PLATFORMS", "default"),
         },

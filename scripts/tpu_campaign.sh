@@ -75,6 +75,17 @@ if ! JAX_PLATFORMS=cpu timeout 600 python scripts/serve_bench.py --smoke \
   echo "$(date +%H:%M:%S) ladder replay smoke failed — campaign aborted (see serve_replay_smoke.log)" >> tpu_poller.log
   exit 1
 fi
+# Model-zoo smoke (CPU, in-process): one scenario manifest must carry a
+# conditional dcgan-mnist from streamed training through publish to
+# per-class ?class=k parity with zero serve-time compiles, boot a
+# WGAN-GP cifar bundle through the same loader, and mux the two
+# architecture-distinct variants with measured costs and a zero-lost
+# ledger (zoo_drill exits nonzero on any invariant breach — docs/ZOO.md).
+if ! JAX_PLATFORMS=cpu timeout 600 python scripts/zoo_drill.py --smoke \
+    --output artifacts/zoo_drill_smoke.json > zoo_drill_smoke.log 2>&1; then
+  echo "$(date +%H:%M:%S) zoo drill smoke failed — campaign aborted (see zoo_drill_smoke.log)" >> tpu_poller.log
+  exit 1
+fi
 # Resilience smoke (CPU, subprocess kill drill): the campaign's long runs
 # survive preemption only if the supervisor/store contract holds — refuse
 # to start if bit-exact resume, corruption quarantine, or the relaunch

@@ -1,0 +1,434 @@
+#!/usr/bin/env python
+"""zoo_drill — the model-zoo end-to-end drill (docs/ZOO.md).
+
+One scenario manifest drives everything the zoo promises, in-process:
+
+1. **conditional dcgan-mnist** — ``ScenarioManifest`` → ``experiment_config``
+   → a tiny training window fed through the STREAMING input pipeline
+   (``zoo/streaming.py`` double buffering behind the iterator contract) →
+   ``publish_for_serving`` (the bundle's ``serving.json`` carries the zoo
+   block) → a live ``InferenceService``. Every class is then served through
+   the conditional sampling kind (``POST /v1/sample?class=k``) and checked
+   BIT-EXACT against the engine's un-staged host path on the same
+   latent+one-hot rows — per-class staged-vs-host parity. After warmup the
+   serve-time compile ledger must stay at zero (the one-hot rides the padded
+   buckets; conditioning adds no compile surface), and the error contract
+   holds: bare latent-width rows 400 with a pointer to ``?class=``,
+   out-of-range classes 400, ``?class=`` on a non-sample kind 400s.
+2. **wgan_gp cifar_shaped** — the second trainable architecture: manifest →
+   config (power-of-two 32×32×3, the WGAN stage constraint) → one critic
+   round through the SAME streaming iterator → publish → the serving loader
+   boots it as family ``wgan_gp`` and samples.
+3. **mux** — both bundles behind one ``MuxRegistry``/``MuxService``: two
+   genuinely DIFFERENT architectures (conditional conv-mnist vs WGAN-GP
+   cifar), each priced by ``measure_bundle_cost`` on the ladder it serves
+   (measured, not declared — docs/QUANT.md), driven concurrently with
+   pinned full-width probes. The exactly-one-answer ledger must hold: every
+   request returns ok, none lost, and the two variants' architectures and
+   measured costs are distinct.
+
+CPU shapes::
+
+    JAX_PLATFORMS=cpu python scripts/zoo_drill.py --smoke \\
+        --output artifacts/zoo_drill_smoke.json
+
+``--record TAG`` additionally writes ``BENCH_zoo_<TAG>.json`` at the repo
+root (the ``zoo`` ledger family — scripts/bench_ledger.py gates on it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def log(msg: str) -> None:
+    print(f"[zoo-drill] {msg}", file=sys.stderr, flush=True)
+
+
+def _streamed_window(dataset: str, batch_size: int, iterations: int,
+                     num_classes: int, seed: int):
+    """A (K, B, F) training window (+ one-hot labels) pulled through the
+    streaming double-buffered iterator — the drill trains through the
+    same data plane docs/ZOO.md ships, not a shortcut around it."""
+    from gan_deeplearning4j_tpu.zoo.datasets import load_dataset
+    from gan_deeplearning4j_tpu.zoo.streaming import (
+        StreamingDataSetIterator,
+        array_source,
+    )
+
+    rows_needed = batch_size * iterations
+    (x, y), _ = load_dataset(dataset, num_train=max(rows_needed, 64),
+                             num_test=16, seed=seed)
+    source, n = array_source(x, y)
+    it = StreamingDataSetIterator(source, n, batch_size=batch_size,
+                                  shuffle=True, seed=seed, block_batches=2)
+    feats, labels = [], []
+    while len(feats) < iterations:
+        if not it.has_next():
+            it.reset()
+        batch = it.next()
+        f = np.asarray(batch.features)
+        if f.shape[0] < batch_size:  # ragged tail: next epoch
+            continue
+        feats.append(f)
+        labels.append(np.eye(num_classes, dtype=np.float32)[
+            np.asarray(batch.labels).astype(int)])
+    it.close()
+    return np.stack(feats), np.stack(labels)
+
+
+def train_conditional(workdir: str, args) -> tuple:
+    """Phase 1a: scenario → streamed tiny train → published zoo bundle."""
+    from gan_deeplearning4j_tpu.harness import GanExperiment
+    from gan_deeplearning4j_tpu.zoo import ScenarioManifest
+
+    scenario = ScenarioManifest(
+        architecture="dcgan", conditioning="class", dataset="mnist",
+        resolution=28, num_classes=10, z_size=4)
+    cfg = scenario.experiment_config(
+        seed=args.seed, batch_size_train=args.batch_size)
+    feats, labels = _streamed_window(
+        "mnist", cfg.batch_size_train, args.iterations, cfg.num_classes,
+        args.seed)
+    exp = GanExperiment(cfg)
+    t0 = time.perf_counter()
+    exp.train_iterations(feats, labels)
+    train_s = time.perf_counter() - t0
+    bundle_dir = os.path.join(workdir, "bundle_cond_mnist")
+    exp.publish_for_serving(bundle_dir)
+    with open(os.path.join(bundle_dir, "serving.json")) as fh:
+        manifest = json.load(fh)
+    log(f"conditional bundle published ({args.iterations} streamed "
+        f"iterations, {train_s:.1f}s): zoo={manifest.get('zoo')}")
+    return bundle_dir, scenario, {
+        "iterations": args.iterations,
+        "train_s": train_s,
+        "zoo_block": manifest.get("zoo"),
+    }
+
+
+def serve_conditional(bundle_dir: str, args, results: dict,
+                      invariants: dict) -> None:
+    """Phase 1b: the conditional sampling kind, per-class parity, zero
+    serve-time compiles, and the 400 contract."""
+    from gan_deeplearning4j_tpu.serving import InferenceService, ServingEngine
+
+    engine = ServingEngine.from_bundle(bundle_dir)
+    engine.warmup()
+    service = InferenceService(engine, warmup=False)
+    classes = engine.class_count
+    latent = engine.latent_width("sample")
+    rng = np.random.default_rng(args.seed + 7)
+    parity = []
+    statuses = []
+    for k in range(classes):
+        z = (rng.random((3, latent), dtype=np.float32) * 2.0 - 1.0)
+        status, body = service.handle(
+            "POST", f"/v1/sample?class={k}", {"data": z.tolist()})
+        statuses.append(status)
+        if status != 200:
+            parity.append(False)
+            continue
+        staged = np.asarray(body["data"], dtype=np.float32)
+        onehot = np.zeros((3, classes), dtype=np.float32)
+        onehot[:, k] = 1.0
+        host = engine.run_host(
+            "sample", np.concatenate([z, onehot], axis=1))
+        parity.append(bool(np.array_equal(staged, np.asarray(host))))
+    serve_compiles = dict(engine.serve_compile_counts)
+    # the 400 contract: bare latent rows, out-of-range class, class on a
+    # non-sample kind
+    z = rng.random((2, latent), dtype=np.float32)
+    st_bare, body_bare = service.handle(
+        "POST", "/v1/sample", {"data": z.tolist()})
+    st_range, _ = service.handle(
+        "POST", f"/v1/sample?class={classes + 2}", {"data": z.tolist()})
+    st_kind, _ = service.handle(
+        "POST", "/v1/classify?class=1",
+        {"data": np.zeros((1, engine.input_width("classify"))).tolist()})
+    service.close()
+    results["conditional"] = {
+        "classes": classes,
+        "latent_width": latent,
+        "parity_per_class": parity,
+        "parity_classes": sum(parity),
+        "serve_compile_counts": serve_compiles,
+        "serve_compiles_total": sum(serve_compiles.values()),
+        "bare_latent_status": st_bare,
+        "out_of_range_status": st_range,
+        "class_on_classify_status": st_kind,
+    }
+    invariants["per_class_parity"] = (
+        len(parity) == classes and all(parity)
+        and all(s == 200 for s in statuses))
+    invariants["zero_serve_time_compiles"] = all(
+        c == 0 for c in serve_compiles.values())
+    invariants["conditional_error_contract"] = (
+        st_bare == 400 and st_range == 400 and st_kind == 400
+        and "class" in (body_bare or {}).get("error", ""))
+    log(f"conditional serving: parity {sum(parity)}/{classes}, "
+        f"serve compiles {serve_compiles}, 400s "
+        f"({st_bare}, {st_range}, {st_kind})")
+
+
+def train_wgan(workdir: str, args) -> tuple:
+    """Phase 2: the second architecture — WGAN-GP on cifar_shaped, one
+    streamed critic-round window, published and boot-checked."""
+    from gan_deeplearning4j_tpu.harness.wgan_experiment import (
+        WganGpExperiment,
+    )
+    from gan_deeplearning4j_tpu.serving import ServingEngine
+    from gan_deeplearning4j_tpu.zoo import ScenarioManifest
+
+    scenario = ScenarioManifest(
+        architecture="wgan_gp", conditioning="none", dataset="cifar_shaped",
+        resolution=32)
+    cfg = scenario.experiment_config(
+        seed=args.seed + 1, batch_size_train=args.batch_size, n_critic=2)
+    feats, _ = _streamed_window(
+        "cifar_shaped", cfg.batch_size_train, max(1, args.iterations // 2),
+        cfg.num_classes, args.seed + 1)
+    exp = WganGpExperiment(cfg)
+    t0 = time.perf_counter()
+    exp.train_iterations(feats)
+    train_s = time.perf_counter() - t0
+    bundle_dir = os.path.join(workdir, "bundle_wgan_cifar")
+    exp.publish_for_serving(bundle_dir)
+    with open(os.path.join(bundle_dir, "serving.json")) as fh:
+        manifest = json.load(fh)
+    engine = ServingEngine.from_bundle(bundle_dir)
+    sample = engine.run_host(
+        "sample",
+        np.zeros((2, engine.input_width("sample")), dtype=np.float32))
+    boots = sample.shape == (2, cfg.num_features)
+    log(f"wgan bundle published ({train_s:.1f}s): family "
+        f"{manifest.get('family')}, zoo={manifest.get('zoo')}, "
+        f"boot sample {sample.shape}")
+    return bundle_dir, scenario, {
+        "train_s": train_s,
+        "family": manifest.get("family"),
+        "zoo_block": manifest.get("zoo"),
+        "boot_sample_ok": boots,
+    }
+
+
+def run_mux(cond_dir: str, wgan_dir: str, args, results: dict,
+            invariants: dict) -> None:
+    """Phase 3: two architecture-distinct zoo variants behind one mux,
+    measured costs, pinned concurrent load, zero-lost ledger."""
+    from gan_deeplearning4j_tpu.quant import measure_bundle_cost
+    from gan_deeplearning4j_tpu.serving.mux import MuxRegistry, MuxService
+    from gan_deeplearning4j_tpu.zoo import scenario_from_bundle
+
+    ladder = tuple(int(b) for b in args.buckets.split(","))
+    # price each variant on the ladder the registry will serve it on (a
+    # variable, not a literal at the seam — JG031): both enter measured
+    measure_bundle_cost(cond_dir, buckets=ladder, rounds=2)
+    measure_bundle_cost(wgan_dir, buckets=ladder, rounds=2)
+    registry = MuxRegistry(
+        buckets=ladder, budget=2,
+        batcher_kwargs={"max_latency": 0.002, "max_queue": 64,
+                        "default_timeout": 10.0})
+    registry.add("cond_mnist", bundle_path=cond_dir, cost=1.0, weight=0.5)
+    registry.add("wgan_cifar", bundle_path=wgan_dir, cost=1.0, weight=0.5)
+    registry.ensure_resident("cond_mnist")
+    registry.ensure_resident("wgan_cifar")
+    svc = MuxService(registry)
+    widths = {
+        name: registry.engine_for(name).input_width("sample")
+        for name in ("cond_mnist", "wgan_cifar")
+    }
+    classes = registry.engine_for("cond_mnist").class_count
+
+    per_thread = max(1, args.mux_requests // (2 * args.mux_threads))
+    counts_lock = threading.Lock()
+    counts = {"sent": 0, "ok": 0, "errors": 0, "answered": 0}
+
+    def client(tid: int, name: str) -> None:
+        rng = np.random.default_rng(args.seed + 100 + tid)
+        for _ in range(per_thread):
+            n = int(rng.integers(1, ladder[-1] + 1))
+            if name == "cond_mnist":
+                z = rng.random(
+                    (n, widths[name] - classes), dtype=np.float32) * 2 - 1
+                onehot = np.eye(classes, dtype=np.float32)[
+                    rng.integers(classes, size=n)]
+                rows = np.concatenate([z, onehot], axis=1)
+            else:
+                rows = rng.random((n, widths[name]), dtype=np.float32) * 2 - 1
+            with counts_lock:
+                counts["sent"] += 1
+            status, body = svc.handle(
+                "POST", "/v1/sample",
+                {"data": rows.tolist(), "model": name})
+            with counts_lock:
+                counts["answered"] += 1
+                if status == 200 and len(body.get("data", [])) == n:
+                    counts["ok"] += 1
+                else:
+                    counts["errors"] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(i, name), daemon=True)
+        for i, name in enumerate(
+            ["cond_mnist", "wgan_cifar"] * args.mux_threads)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    costs = registry.costs()
+    sources = registry.cost_sources()
+    arch = {
+        "cond_mnist": getattr(
+            scenario_from_bundle(cond_dir), "architecture", None),
+        "wgan_cifar": getattr(
+            scenario_from_bundle(wgan_dir), "architecture", None),
+    }
+    mux_counts = _served_per_model()
+    svc.close()
+    results["mux"] = {
+        "ladder": list(ladder),
+        "widths": widths,
+        "architectures": arch,
+        "costs": costs,
+        "cost_sources": sources,
+        "sent": counts["sent"],
+        "answered": counts["answered"],
+        "ok": counts["ok"],
+        "errors": counts["errors"],
+        "lost": counts["sent"] - counts["answered"],
+        "elapsed_s": elapsed,
+        "served_per_model": mux_counts,
+    }
+    invariants["mux_architectures_distinct"] = (
+        arch["cond_mnist"] == "dcgan" and arch["wgan_cifar"] == "wgan_gp"
+        and widths["cond_mnist"] != widths["wgan_cifar"])
+    invariants["mux_costs_measured_and_distinct"] = (
+        sources.get("cond_mnist") == "measured"
+        and sources.get("wgan_cifar") == "measured"
+        and costs["cond_mnist"] != costs["wgan_cifar"])
+    invariants["mux_both_variants_serve"] = (
+        mux_counts.get("cond_mnist", 0) > 0
+        and mux_counts.get("wgan_cifar", 0) > 0)
+    invariants["mux_zero_lost"] = (
+        counts["sent"] == counts["answered"] == counts["ok"]
+        and counts["errors"] == 0)
+    log(f"mux: {counts['ok']}/{counts['sent']} ok in {elapsed:.1f}s, "
+        f"costs {costs} ({sources}), architectures {arch}")
+
+
+def _served_per_model() -> dict:
+    from gan_deeplearning4j_tpu.telemetry.registry import get_registry
+
+    out: dict = {}
+    for s in (get_registry().snapshot()
+              .get("mux_requests_total", {}).get("series", [])):
+        labels = s.get("labels", {})
+        if labels.get("status") == "ok":
+            out[labels.get("model")] = (
+                out.get(labels.get("model"), 0) + float(s.get("value", 0)))
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="campaign/CI shape: tiny windows, short mux load")
+    p.add_argument("--iterations", type=int, default=4,
+                   help="conditional training window length K")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--buckets", default="1,8",
+                   help="mux ladder (comma ints); variants are priced on it")
+    p.add_argument("--mux-requests", type=int, default=96)
+    p.add_argument("--mux-threads", type=int, default=3,
+                   help="client threads PER VARIANT in the mux phase")
+    p.add_argument("--seed", type=int, default=666)
+    p.add_argument("--workdir", default=None,
+                   help="keep bundles here instead of a temp dir")
+    p.add_argument("--output", default=None, metavar="PATH")
+    p.add_argument("--record", default=None, metavar="TAG",
+                   help="also write BENCH_zoo_<TAG>.json at the repo root")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        args.iterations = min(args.iterations, 2)
+        args.mux_requests = min(args.mux_requests, 48)
+        args.mux_threads = min(args.mux_threads, 2)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="zoo_drill_")
+    cleanup = args.workdir is None
+    os.makedirs(workdir, exist_ok=True)
+    results: dict = {}
+    invariants: dict = {}
+    t_start = time.monotonic()
+
+    cond_dir, _, cond_info = train_conditional(workdir, args)
+    results["conditional_train"] = cond_info
+    invariants["conditional_bundle_declares_zoo"] = (
+        (cond_info["zoo_block"] or {}).get("conditioning") == "class")
+    serve_conditional(cond_dir, args, results, invariants)
+
+    wgan_dir, _, wgan_info = train_wgan(workdir, args)
+    results["wgan_train"] = wgan_info
+    invariants["wgan_bundle_is_wgan_family"] = (
+        wgan_info["family"] == "wgan_gp"
+        and (wgan_info["zoo_block"] or {}).get("architecture") == "wgan_gp"
+        and wgan_info["boot_sample_ok"])
+
+    run_mux(cond_dir, wgan_dir, args, results, invariants)
+
+    ok = all(invariants.values()) and bool(invariants)
+    payload = {
+        "bench": "zoo_drill",
+        "config": {
+            "smoke": bool(args.smoke),
+            "seed": args.seed,
+            "iterations": args.iterations,
+            "batch_size": args.batch_size,
+            "buckets": args.buckets,
+            "mux_requests": args.mux_requests,
+            "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+        "wall_seconds": time.monotonic() - t_start,
+        "results": results,
+        "invariants": invariants,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=2)
+    print(text)
+    if args.output:
+        os.makedirs(os.path.dirname(os.path.abspath(args.output)),
+                    exist_ok=True)
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+    if args.record:
+        with open(os.path.join(_REPO, f"BENCH_zoo_{args.record}.json"),
+                  "w") as fh:
+            fh.write(text + "\n")
+    if cleanup and ok:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not ok:
+        log(f"INVARIANT BREACH — bundles kept at {workdir}")
+    for name, good in sorted(invariants.items()):
+        log(f"invariant {name}: {'ok' if good else 'BREACH'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

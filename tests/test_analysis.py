@@ -4051,6 +4051,102 @@ class TestHardcodedLadderLiteral:
 
 
 # ===========================================================================
+# JG032 — double buffer consumed while its overlapped fill is in flight
+# ===========================================================================
+
+class TestDoubleBufferMisuse:
+    def test_true_positive_read_without_fence(self):
+        # the classic double-buffering bug: the fill is issued against
+        # `back`, then the consumer slices it with no fence — torn batches
+        r = run(
+            "def pump(pool, back, fill, n):\n"
+            "    fut = pool.submit(fill, back, n)\n"
+            "    first = back[0:n]\n"
+            "    return first, fut\n"
+        )
+        assert codes(r) == ["JG032"]
+        assert "fence" in r.active[0].message
+
+    def test_true_positive_iteration_is_consumption(self):
+        # for-iteration over the in-flight buffer is a read, same hazard
+        r = run(
+            "def drain(pool, buf):\n"
+            "    pool.submit(self_refill, buf)\n"
+            "    total = 0\n"
+            "    for row in buf:\n"
+            "        total += row\n"
+            "    return total\n"
+        )
+        assert codes(r) == ["JG032"]
+
+    def test_true_positive_thread_target_args(self):
+        # Thread(target=..., args=(buf,)) is the same overlapped fill
+        r = run(
+            "import threading\n"
+            "def pump(buf, prefetch_rows):\n"
+            "    t = threading.Thread(target=prefetch_rows, args=(buf,))\n"
+            "    t.start()\n"
+            "    return buf[0]\n"
+        )
+        assert codes(r) == ["JG032"]
+
+    def test_true_negative_fence_then_read(self):
+        # zoo/streaming.py's discipline: result() fences the worker, the
+        # read after it observes a fully-written buffer
+        r = run(
+            "def pump(pool, back, fill, n):\n"
+            "    fut = pool.submit(fill, back, n)\n"
+            "    fut.result()\n"
+            "    return back[0:n]\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_swap_retires_buffer(self):
+        # the tuple swap rebinds the names: post-swap reads refer to the
+        # retired (fully written) storage, not the in-flight one
+        r = run(
+            "def pump(pool, front, back, fill):\n"
+            "    pool.submit(fill, back)\n"
+            "    front, back = back, front\n"
+            "    return front[0], back[0]\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_read_before_issue(self):
+        # consume-then-refill, the other legal ordering: the read
+        # precedes the issue, so nothing in flight is observed
+        r = run(
+            "def pump(pool, buf, refill, n):\n"
+            "    head = buf[0:n]\n"
+            "    fut = pool.submit(refill, buf)\n"
+            "    return head, fut\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_callee_without_fill_seam(self):
+        # submit of a non-fill worker (a scorer, a logger) does not make
+        # its arguments buffers — no naming seam, no hazard
+        r = run(
+            "def score(pool, rows, scorer):\n"
+            "    fut = pool.submit(scorer, rows)\n"
+            "    return rows[0], fut\n"
+        )
+        assert codes(r) == []
+
+    def test_true_negative_thread_join_is_fence(self):
+        # join() on the worker thread is as strong as result()
+        r = run(
+            "import threading\n"
+            "def pump(buf, prefetch_rows):\n"
+            "    t = threading.Thread(target=prefetch_rows, args=(buf,))\n"
+            "    t.start()\n"
+            "    t.join()\n"
+            "    return buf[0]\n"
+        )
+        assert codes(r) == []
+
+
+# ===========================================================================
 # JG025 cross-class unification (satellite on the concurrency index)
 # ===========================================================================
 
